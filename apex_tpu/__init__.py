@@ -1,0 +1,37 @@
+"""apex_tpu — a TPU-native (JAX/XLA/Pallas) framework with the capabilities of NVIDIA/apex.
+
+Reference surface: ``apex/__init__.py:14-18`` exports ``optimizers`` and ``normalization``;
+this package additionally re-exposes the capabilities of the removed-but-in-scope legacy
+packages (``apex.amp``, ``apex.parallel``) and ``apex.contrib`` as TPU-idiomatic
+equivalents (see SURVEY.md).
+
+Design notes
+------------
+- Compute path is JAX/XLA with Pallas kernels for the hot ops (optimizer updates,
+  normalization, softmax, attention). Everything is jittable and shardable with
+  ``jax.sharding`` over a ``Mesh``.
+- Mixed precision is bf16-first: the fp16 dynamic-loss-scaling machinery of the
+  reference (``csrc/multi_tensor_scale_kernel.cu``, ``csrc/update_scale_hysteresis.cu``)
+  exists as an optional, fully-jitted state machine in :mod:`apex_tpu.amp`.
+- Distributed training rides XLA collectives over ICI/DCN (psum / psum_scatter /
+  all_gather / ppermute) instead of NCCL; see :mod:`apex_tpu.parallel` and the
+  distributed optimizers in :mod:`apex_tpu.optimizers`.
+"""
+
+from apex_tpu import optimizers  # noqa: F401
+from apex_tpu import normalization  # noqa: F401
+from apex_tpu import multi_tensor  # noqa: F401
+from apex_tpu import amp  # noqa: F401
+from apex_tpu import parallel  # noqa: F401
+from apex_tpu import ops  # noqa: F401
+from apex_tpu import contrib  # noqa: F401
+from apex_tpu import utils  # noqa: F401
+
+__version__ = "0.1.0"
+
+import warnings as _warnings
+
+
+def deprecated_warning(msg: str) -> None:
+    """Parity shim for ``apex.deprecated_warning`` (apex/__init__.py:37-43)."""
+    _warnings.warn(msg, FutureWarning, stacklevel=2)

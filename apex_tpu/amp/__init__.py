@@ -1,0 +1,18 @@
+"""amp — mixed-precision machinery, TPU equivalent of the removed ``apex.amp``
+package (spec: tests/L1/common/main_amp.py:21-24, run matrix
+tests/L1/common/run_test.sh:29-49) and the ``amp_C`` loss-scaling kernels.
+
+TPU reality: bf16 training needs no loss scaling, so O1/O2 become dtype
+policies; the fp16 dynamic-loss-scale state machine survives as an optional,
+fully-jitted component (``DynamicGradScaler``), with the exact hysteresis
+semantics of csrc/update_scale_hysteresis.cu:5-41.
+"""
+
+from apex_tpu.amp.policy import Policy, initialize  # noqa: F401
+from apex_tpu.amp.grad_scaler import (  # noqa: F401
+    DynamicGradScaler,
+    GradScaler,
+    ScalerState,
+    scale_loss,
+)
+from apex_tpu.amp._cast_utils import cast_to, cast_if_autocast_enabled  # noqa: F401

@@ -1,0 +1,24 @@
+"""Cast helpers ≈ ``apex/_autocast_utils.py:22-26`` (``_cast_if_autocast_enabled``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _is_float(x) -> bool:
+    return hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def cast_to(dtype, *args):
+    """Cast every floating leaf of args to ``dtype``; pass others through."""
+    out = jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if _is_float(x) else x, args)
+    return out if len(args) != 1 else out[0]
+
+
+def cast_if_autocast_enabled(compute_dtype, *args):
+    """Signature-parity shim: in JAX autocast is the explicit policy dtype."""
+    if compute_dtype is None:
+        return args if len(args) != 1 else args[0]
+    return cast_to(compute_dtype, *args)

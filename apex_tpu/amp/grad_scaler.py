@@ -1,0 +1,110 @@
+"""Dynamic loss scaling — TPU equivalent of the amp_C scaling family.
+
+Kernels replaced (all jitted, no host sync — the "capturable" goal of the
+reference's GradScaler integration, apex/optimizers/fused_adam.py:236-252):
+- ``multi_tensor_scale`` (csrc/multi_tensor_scale_kernel.cu) → scale/unscale with
+  found_inf detection
+- ``update_scale_hysteresis`` (csrc/update_scale_hysteresis.cu:5-41) → growth /
+  backoff state machine with hysteresis
+
+State lives in a ``ScalerState`` pytree carried through the train step, so the
+whole fp16 flow (scale loss → backward → unscale+check → conditional step →
+scale update) stays inside one jit (SURVEY §7 hard part (f)).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.functional import (multi_tensor_scale,
+                                              tree_check_finite,
+                                              update_scale_hysteresis)
+
+
+class ScalerState(NamedTuple):
+    scale: jax.Array            # f32 scalar
+    growth_tracker: jax.Array   # i32 scalar
+    hysteresis_tracker: jax.Array  # i32 scalar
+
+    @classmethod
+    def create(cls, init_scale: float = 2.0 ** 16, hysteresis: int = 1):
+        return cls(jnp.float32(init_scale), jnp.int32(0),
+                   jnp.int32(hysteresis))
+
+
+def scale_loss(loss: jax.Array, state: ScalerState) -> jax.Array:
+    """``with amp.scale_loss(loss, opt)`` equivalent: loss * scale."""
+    return loss * state.scale.astype(loss.dtype)
+
+
+class DynamicGradScaler:
+    """Pure-functional dynamic scaler (configuration only; state is explicit).
+
+    Hyperparameters mirror torch.amp.GradScaler + apex hysteresis.
+    """
+
+    def __init__(self, init_scale: float = 2.0 ** 16,
+                 growth_factor: float = 2.0, backoff_factor: float = 0.5,
+                 growth_interval: int = 2000, hysteresis: int = 1,
+                 enabled: bool = True):
+        self.init_scale = init_scale
+        self.growth_factor = growth_factor
+        self.backoff_factor = backoff_factor
+        self.growth_interval = growth_interval
+        self.hysteresis = hysteresis
+        self.enabled = enabled
+
+    def init(self) -> ScalerState:
+        return ScalerState.create(self.init_scale, self.hysteresis)
+
+    def scale(self, loss, state: ScalerState):
+        if not self.enabled:
+            return loss
+        return scale_loss(loss, state)
+
+    def unscale(self, grads: Any, state: ScalerState) -> Tuple[Any, jax.Array]:
+        """Unscale grads, returning (unscaled_grads, found_inf)."""
+        if not self.enabled:
+            return grads, jnp.zeros((), jnp.bool_)
+        inv = 1.0 / state.scale
+        return multi_tensor_scale(grads, inv)
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        """Advance the scale state machine given this step's found_inf."""
+        if not self.enabled:
+            return state
+        s, g, h = update_scale_hysteresis(
+            state.scale, state.growth_tracker, state.hysteresis_tracker,
+            found_inf, self.growth_factor, self.backoff_factor,
+            self.growth_interval, self.hysteresis)
+        return ScalerState(s, g, h)
+
+
+class GradScaler(DynamicGradScaler):
+    """Stateful torch.amp.GradScaler-style facade for host-driven loops.
+
+    ``scaler.step(opt, grads)`` = unscale + inf check + (no-op'd) optimizer
+    step + scale update, matching the modern reference flow
+    (examples/imagenet/main_amp.py:153-154).
+    """
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.state = self.init()
+
+    def step(self, optimizer, grads: Any, lr=None):
+        inv_scale = 1.0 / self.state.scale
+        # finiteness of the scaled grads == finiteness of the grads: probe
+        # without materializing an unscaled copy (the optimizer applies
+        # inv_scale inside its fused update)
+        found_inf = tree_check_finite(grads)
+        params = optimizer.step(grads, lr=lr, inv_scale=inv_scale,
+                                found_inf=found_inf)
+        self.state = self.update(self.state, found_inf)
+        return params
+
+    def get_scale(self):
+        return float(self.state.scale)

@@ -1,0 +1,94 @@
+"""amp opt-level policies — TPU equivalent of ``amp.initialize`` O0–O3 semantics
+(legacy surface spec'd by tests/L1/common/run_test.sh:29-49 and
+tests/L1/common/main_amp.py:21-24).
+
+On TPU the opt levels become dtype policies (SURVEY §7 step 4):
+- O0: fp32 params, fp32 compute (pure fp32 baseline)
+- O1: fp32 params, bf16 compute at op boundaries ("autocast" ≈ policy casts)
+- O2: low-precision params + fp32 master weights in the optimizer
+- O3: pure low-precision ("speed of light" mode)
+
+``keep_batchnorm_fp32`` survives as a policy field consumed by the
+normalization/model layers; ``loss_scale`` selects None / static / dynamic
+scaling (only meaningful for fp16).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.grad_scaler import DynamicGradScaler
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    opt_level: str
+    param_dtype: Any
+    compute_dtype: Any
+    output_dtype: Any
+    keep_batchnorm_fp32: bool
+    loss_scale: Union[None, float, str]  # None | static value | "dynamic"
+    master_weights: bool
+
+    @classmethod
+    def from_opt_level(cls, opt_level: str = "O1",
+                       low_dtype=jnp.bfloat16,
+                       keep_batchnorm_fp32: Optional[bool] = None,
+                       loss_scale: Union[None, float, str] = None) -> "Policy":
+        ol = opt_level.upper()
+        if ol == "O0":
+            return cls(ol, jnp.float32, jnp.float32, jnp.float32,
+                       True, None, False)
+        if ol == "O1":
+            return cls(ol, jnp.float32, low_dtype, jnp.float32,
+                       True if keep_batchnorm_fp32 is None
+                       else keep_batchnorm_fp32, loss_scale, False)
+        if ol == "O2":
+            return cls(ol, low_dtype, low_dtype, low_dtype,
+                       True if keep_batchnorm_fp32 is None
+                       else keep_batchnorm_fp32, loss_scale, True)
+        if ol == "O3":
+            return cls(ol, low_dtype, low_dtype, low_dtype,
+                       False if keep_batchnorm_fp32 is None
+                       else keep_batchnorm_fp32, loss_scale, False)
+        raise ValueError(f"Unexpected optimization level {opt_level}")
+
+    # -- helpers consumed by models / train loops ---------------------------
+    def cast_params(self, params: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda p: p.astype(self.param_dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+    def cast_inputs(self, x: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda v: v.astype(self.compute_dtype)
+            if jnp.issubdtype(v.dtype, jnp.floating) else v, x)
+
+    def make_scaler(self) -> Optional[DynamicGradScaler]:
+        if self.loss_scale is None:
+            return None
+        if self.loss_scale == "dynamic":
+            return DynamicGradScaler()
+        return DynamicGradScaler(init_scale=float(self.loss_scale),
+                                 growth_interval=2 ** 31 - 1,
+                                 growth_factor=1.0, backoff_factor=1.0)
+
+
+def initialize(params: Any, optimizer=None, opt_level: str = "O1",
+               keep_batchnorm_fp32: Optional[bool] = None,
+               loss_scale: Union[None, float, str] = None,
+               low_dtype=jnp.bfloat16):
+    """≈ ``amp.initialize(model, opt, opt_level=...)``.
+
+    Returns ``(cast_params, optimizer, policy, scaler_or_None)``. The caller
+    runs the model with policy.cast_inputs / compute_dtype and feeds the scaler
+    into the optimizer step (see apex_tpu.amp.grad_scaler).
+    """
+    policy = Policy.from_opt_level(opt_level, low_dtype, keep_batchnorm_fp32,
+                                   loss_scale)
+    cast = policy.cast_params(params)
+    return cast, optimizer, policy, policy.make_scaler()

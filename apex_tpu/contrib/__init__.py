@@ -1,0 +1,1 @@
+"""contrib — TPU equivalents of ``apex/contrib`` packages (built out per SURVEY §2.3/2.4)."""

@@ -1,0 +1,2 @@
+"""Model zoo used by the benchmark/integration configs (BASELINE.md):
+ResNet-50 (configs 2-3), BERT-large (config 4), GPT-2 (config 5)."""

@@ -1,0 +1,12 @@
+from apex_tpu.multi_tensor.functional import (  # noqa: F401
+    multi_tensor_scale,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_unscale_l2norm,
+    tree_check_finite,
+    update_scale_hysteresis,
+)
+from apex_tpu.multi_tensor.multi_tensor_apply import (  # noqa: F401
+    MultiTensorApply,
+    multi_tensor_applier,
+)

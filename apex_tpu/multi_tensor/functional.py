@@ -1,0 +1,166 @@
+"""Fused multi-tensor primitives — TPU equivalent of the ``amp_C`` kernel family.
+
+Reference kernels (all built on ``csrc/multi_tensor_apply.cuh:32-103``):
+- ``multi_tensor_scale``      csrc/multi_tensor_scale_kernel.cu   (out = in*scale + inf check)
+- ``multi_tensor_axpby``      csrc/multi_tensor_axpby_kernel.cu   (out = a*x + b*y + inf check)
+- ``multi_tensor_l2norm``     csrc/multi_tensor_l2norm_kernel.cu  (global + per-tensor norms)
+- ``multi_tensor_unscale_l2norm``  csrc/amp_C_frontend.cpp:13-28  (fused unscale + norm)
+- ``update_scale_hysteresis`` csrc/update_scale_hysteresis.cu:5-41
+
+TPU design: the reference's win is one kernel launch over ~110 tensors instead of
+hundreds of launches. Under ``jax.jit`` the whole pytree update traces into ONE XLA
+program and the elementwise work fuses into a handful of HBM-bandwidth-bound fused
+loops — the launch-overhead problem the CUDA harness solves does not exist. What we
+keep from the reference is the *semantics*: a single ``found_inf`` no-op flag
+predicating the whole update (``noop_flag`` in the CUDA kernels), fp32 math
+irrespective of storage dtype, and global-norm reductions computed alongside.
+
+Everything here is a pure jittable function over pytrees.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_check_finite(tree: Any) -> jax.Array:
+    """Public found_inf check: True if ANY element of the pytree is inf/nan,
+    without materializing any scaled copy (cheapest possible overflow probe)."""
+    return _tree_any_nonfinite(tree)
+
+
+def _tree_any_nonfinite(tree: Any) -> jax.Array:
+    """found_inf over a pytree: True if any element is inf/nan.
+
+    Mirrors the ``noop_flag`` side-channel every amp_C kernel writes
+    (e.g. csrc/multi_tensor_scale_kernel.cu ``ScaleFunctor``).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.bool_)
+    flags = [~jnp.all(jnp.isfinite(l.astype(jnp.float32))) for l in leaves]
+    acc = flags[0]
+    for f in flags[1:]:
+        acc = acc | f
+    return acc
+
+
+def multi_tensor_scale(tree: Any, scale: jax.Array | float,
+                       check_finite: bool = True) -> Tuple[Any, jax.Array]:
+    """``out = in * scale`` with inf/nan detection (the loss-(un)scaling primitive).
+
+    Returns ``(scaled_tree, found_inf)``. Math in fp32, output in input dtype —
+    matching ``ScaleFunctor``'s load-as-fp32 behavior.
+    """
+    scale = jnp.asarray(scale, jnp.float32)
+
+    def _s(x):
+        return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+    out = jax.tree_util.tree_map(_s, tree)
+    found_inf = (_tree_any_nonfinite(tree) if check_finite
+                 else jnp.zeros((), jnp.bool_))
+    return out, found_inf
+
+
+def multi_tensor_axpby(a: jax.Array | float, x_tree: Any,
+                       b: jax.Array | float, y_tree: Any,
+                       out_dtype=None) -> Tuple[Any, jax.Array]:
+    """``out = a*x + b*y`` + inf check (master-grad accumulation primitive).
+
+    Reference: csrc/multi_tensor_axpby_kernel.cu ``AxpbyFunctor``.
+    """
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    def _axpby(x, y):
+        r = a * x.astype(jnp.float32) + b * y.astype(jnp.float32)
+        return r.astype(out_dtype or x.dtype)
+
+    out = jax.tree_util.tree_map(_axpby, x_tree, y_tree)
+    return out, _tree_any_nonfinite(out)
+
+
+def multi_tensor_l2norm(tree: Any, per_tensor: bool = False):
+    """Global L2 norm across a pytree, optionally per-tensor norms too.
+
+    Reference: csrc/multi_tensor_l2norm_kernel.cu (two-stage per-chunk partials +
+    ``cleanup`` reduction). XLA's reduction already tiles this; we accumulate in
+    fp32 like the kernel's ``float`` accumulators.
+
+    Returns ``(global_norm, per_tensor_norms|None)`` with fp32 scalars.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        z = jnp.zeros((), jnp.float32)
+        return z, (jnp.zeros((0,), jnp.float32) if per_tensor else None)
+    sqs = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves]
+    total = sqs[0]
+    for s in sqs[1:]:
+        total = total + s
+    gnorm = jnp.sqrt(total)
+    if per_tensor:
+        return gnorm, jnp.sqrt(jnp.stack(sqs))
+    return gnorm, None
+
+
+def multi_tensor_unscale_l2norm(tree: Any, inv_scale: jax.Array | float,
+                                per_tensor: bool = False):
+    """Fused unscale + L2 norm (ref csrc/amp_C_frontend.cpp:13-28).
+
+    Returns ``(unscaled_tree, global_norm, per_tensor_norms|None, found_inf)``.
+    """
+    inv_scale = jnp.asarray(inv_scale, jnp.float32)
+
+    def _u(x):
+        return (x.astype(jnp.float32) * inv_scale).astype(x.dtype)
+
+    out = jax.tree_util.tree_map(_u, tree)
+    gnorm, pt = multi_tensor_l2norm(out, per_tensor)
+    return out, gnorm, pt, _tree_any_nonfinite(tree)
+
+
+def update_scale_hysteresis(scale: jax.Array, growth_tracker: jax.Array,
+                            hysteresis_tracker: jax.Array, found_inf: jax.Array,
+                            growth_factor: float = 2.0,
+                            backoff_factor: float = 0.5,
+                            growth_interval: int = 2000,
+                            hysteresis: int = 1):
+    """Dynamic loss-scale growth/backoff with hysteresis.
+
+    Jittable port of the single-thread state machine in
+    csrc/update_scale_hysteresis.cu:5-41, matching it branch for branch:
+      - found_inf: hysteresis -= 1; while still > 0 only the growth tracker
+        resets (no backoff yet); once ≤ 0, every further inf step backs the
+        scale off. Hysteresis is NOT replenished by a backoff.
+      - clean step: growth_tracker += 1; at growth_interval the scale grows
+        only if the result is finite (no growth past fp32 max); hysteresis is
+        replenished to full.
+
+    Returns ``(scale, growth_tracker, hysteresis_tracker)`` as jnp scalars.
+    """
+    scale = jnp.asarray(scale, jnp.float32)
+    growth_tracker = jnp.asarray(growth_tracker, jnp.int32)
+    hysteresis_tracker = jnp.asarray(hysteresis_tracker, jnp.int32)
+    found_inf = jnp.asarray(found_inf, jnp.bool_)
+
+    # found_inf branch
+    hys_after = hysteresis_tracker - 1
+    backoff_now = found_inf & (hys_after <= 0)
+    scale_inf = jnp.where(backoff_now, scale * backoff_factor, scale)
+
+    # clean branch
+    gt_after = growth_tracker + 1
+    grow_now = gt_after == growth_interval
+    grown = scale * growth_factor
+    grown = jnp.where(jnp.isfinite(grown), grown, scale)
+    scale_ok = jnp.where(grow_now, grown, scale)
+    gt_ok = jnp.where(grow_now, jnp.int32(0), gt_after)
+
+    new_scale = jnp.where(found_inf, scale_inf, scale_ok)
+    new_gt = jnp.where(found_inf, jnp.int32(0), gt_ok)
+    new_hys = jnp.where(found_inf, hys_after, jnp.int32(hysteresis))
+    return new_scale, new_gt, new_hys

@@ -1,0 +1,43 @@
+"""``multi_tensor_applier`` facade — parity with
+``apex/multi_tensor_apply/multi_tensor_apply.py:1-27``.
+
+The reference wraps every amp_C kernel behind
+``multi_tensor_applier(op, noop_flag_buffer, tensor_lists, *args)``. On TPU the
+"op" is a jittable functor over same-length lists of arrays; one traced call
+covers the whole list (the XLA analog of one chunked kernel launch over ≤110
+tensors, csrc/multi_tensor_apply.cuh:13-23).
+
+``noop_flag`` becomes a returned ``found_inf`` scalar instead of a mutated
+buffer — callers predicate their update with ``jnp.where`` (functional JAX has
+no in-place side channel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+
+class MultiTensorApply:
+    """Callable singleton mirroring ``MultiTensorApply(2048*32)``.
+
+    ``chunk_size`` is accepted for API parity; XLA chooses its own tiling so it
+    is advisory only.
+    """
+
+    available = True
+    warned = False
+
+    def __init__(self, chunk_size: int = 2048 * 32):
+        self.chunk_size = chunk_size
+
+    def __call__(self, op: Callable, noop_flag: Any,
+                 tensor_lists: Sequence[Sequence], *args):
+        """Apply ``op(tensor_lists, *args)``; returns whatever op returns.
+
+        ``noop_flag`` is ignored (kept for signature parity with
+        multi_tensor_apply.py:24-27); ops return found_inf explicitly.
+        """
+        return op(tensor_lists, *args)
+
+
+multi_tensor_applier = MultiTensorApply(2048 * 32)

@@ -1,0 +1,14 @@
+"""Normalization — parity with ``apex/normalization/__init__.py``."""
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    FusedLayerNorm,
+    FusedRMSNorm,
+    MixedFusedLayerNorm,
+    MixedFusedRMSNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+    manual_layer_norm,
+    manual_rms_norm,
+)

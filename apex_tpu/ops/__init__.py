@@ -1,0 +1,1 @@
+"""Kernel layer: Pallas TPU kernels and XLA-fused ops (≈ csrc/ + contrib csrc)."""

@@ -1,0 +1,273 @@
+"""Pallas TPU kernels for fused LayerNorm / RMSNorm forward + backward.
+
+TPU-native equivalent of ``csrc/layer_norm_cuda_kernel.cu``:
+- fwd ``cuApplyLayerNorm``/``cuApplyRMSNorm`` (:366,373) with rowwise Welford
+  stats (:52) → here a rowwise mean/var in fp32 on the VPU.
+- bwd two-stage dgamma/dbeta (``cuComputePartGradGammaBeta`` :482 →
+  per-grid-block partials; ``cuComputeGradGammaBeta`` :557 → final XLA reduce)
+  and ``cuComputeGradInput`` (:609) → per-row dx kernel.
+- ``memory_efficient`` saves (output, invvar) and reconstructs the input from
+  the output in backward (reference frontend fused_layer_norm.py:53-56).
+
+Stats are always fp32 regardless of IO dtype (mixed-dtype paths of
+``layer_norm_cuda.cpp:253-269``).
+
+Layout: input reshaped to (rows, hidden); grid over row-blocks; gamma/beta
+broadcast to every block. Hidden sizes not 128-lane friendly fall back to the
+jnp reference implementation in apex_tpu/normalization/fused_layer_norm.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils.env import interpret_default
+
+_f32 = jnp.float32
+
+
+SUBLANE = 8
+
+
+def _pick_block_rows(rows: int, hidden: int) -> int:
+    # keep ~4 operand blocks under a few MiB of VMEM; rows is a multiple of 8
+    budget = 2 * 1024 * 1024 // max(hidden * 4, 1)
+    br = 256
+    while br > budget and br > SUBLANE:
+        br //= 2
+    while rows % br != 0 and br > SUBLANE:
+        br //= 2
+    return max(br, SUBLANE)
+
+
+def _pad_rows(x: jax.Array):
+    rows = x.shape[0]
+    pad = (-rows) % SUBLANE
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+    return x, rows
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, invvar_ref, *,
+                   eps: float, rms: bool, affine: bool):
+    x = x_ref[...].astype(_f32)
+    if rms:
+        var = jnp.mean(x * x, axis=1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        xhat = x * rstd
+        mean_ref[...] = jnp.zeros_like(rstd)
+    else:
+        mu = jnp.mean(x, axis=1, keepdims=True)
+        xc = x - mu
+        var = jnp.mean(xc * xc, axis=1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + eps)
+        xhat = xc * rstd
+        mean_ref[...] = mu
+    invvar_ref[...] = rstd
+    if affine:
+        y = xhat * g_ref[...].astype(_f32)
+        if b_ref is not None:
+            y = y + b_ref[...].astype(_f32)
+    else:
+        y = xhat
+    y_ref[...] = y.astype(y_ref.dtype)
+
+
+def ln_fwd_pallas(x2: jax.Array, gamma, beta, *, eps: float, rms: bool,
+                  interpret: bool | None = None):
+    """x2: (rows, hidden). Returns (y, mean, invvar) with fp32 stats."""
+    if interpret is None:
+        interpret = interpret_default()
+    x2, true_rows = _pad_rows(x2)
+    rows, hidden = x2.shape
+    br = _pick_block_rows(rows, hidden)
+    grid = (pl.cdiv(rows, br),)
+    affine = gamma is not None
+    has_beta = beta is not None
+
+    in_specs = [pl.BlockSpec((br, hidden), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)]
+    args = [x2]
+    if affine:
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(gamma.reshape(1, hidden))
+    if has_beta:
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(beta.reshape(1, hidden))
+
+    def kernel(*refs):
+        if affine and has_beta:
+            x_ref, g_ref, b_ref, y_ref, mean_ref, invvar_ref = refs
+        elif affine:
+            x_ref, g_ref, y_ref, mean_ref, invvar_ref = refs
+            b_ref = None
+        else:
+            x_ref, y_ref, mean_ref, invvar_ref = refs
+            g_ref = b_ref = None
+        _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, invvar_ref,
+                       eps=eps, rms=rms, affine=affine)
+
+    y, mean, invvar = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((br, hidden), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM),
+                   pl.BlockSpec((br, 1), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((rows, hidden), x2.dtype),
+                   jax.ShapeDtypeStruct((rows, 1), _f32),
+                   jax.ShapeDtypeStruct((rows, 1), _f32)],
+        interpret=interpret,
+    )(*args)
+    if true_rows != rows:
+        y, mean, invvar = y[:true_rows], mean[:true_rows], invvar[:true_rows]
+    return y, mean, invvar
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _ln_bwd_kernel(dy_ref, s_ref, g_ref, b_ref, mean_ref, invvar_ref,
+                   dx_ref, dgp_ref, dbp_ref, *, rms: bool, affine: bool,
+                   memory_efficient: bool):
+    dy = dy_ref[...].astype(_f32)
+    s = s_ref[...].astype(_f32)  # x (normal) or y (memory_efficient)
+    rstd = invvar_ref[...]
+    hidden = dy.shape[1]
+
+    if memory_efficient:
+        # reconstruct xhat from output (layer_norm_cuda_kernel.cu MemoryEfficient)
+        if affine:
+            g = g_ref[...].astype(_f32)
+            if not rms and b_ref is not None:
+                xhat = (s - b_ref[...].astype(_f32)) / g
+            else:
+                xhat = s / g
+        else:
+            xhat = s
+    else:
+        if rms:
+            xhat = s * rstd
+        else:
+            xhat = (s - mean_ref[...]) * rstd
+
+    wdy = dy * g_ref[...].astype(_f32) if affine else dy
+    c1 = jnp.mean(xhat * wdy, axis=1, keepdims=True)
+    if rms:
+        dx = (wdy - xhat * c1) * rstd
+    else:
+        c2 = jnp.mean(wdy, axis=1, keepdims=True)
+        dx = (wdy - xhat * c1 - c2) * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    if affine:
+        # dgamma/dbeta accumulated across the (sequential) grid into one
+        # (1, hidden) block — the role of the two-stage partial buffers in
+        # cuComputePartGradGammaBeta/cuComputeGradGammaBeta (:482,:557).
+        first = pl.program_id(0) == 0
+
+        @pl.when(first)
+        def _init():
+            dgp_ref[...] = jnp.zeros_like(dgp_ref)
+            if dbp_ref is not None:
+                dbp_ref[...] = jnp.zeros_like(dbp_ref)
+
+        dgp_ref[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+        if dbp_ref is not None:
+            dbp_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
+
+
+def ln_bwd_pallas(dy2, saved2, gamma, beta, mean, invvar, *, rms: bool,
+                  memory_efficient: bool, interpret: bool | None = None):
+    """Returns (dx, dgamma|None, dbeta|None). saved2 = x2 or y2 (mem-efficient)."""
+    if interpret is None:
+        interpret = interpret_default()
+    dy2, true_rows = _pad_rows(dy2)
+    saved2, _ = _pad_rows(saved2)
+    mean, _ = _pad_rows(mean)
+    invvar, _ = _pad_rows(invvar)
+    rows, hidden = dy2.shape
+    br = _pick_block_rows(rows, hidden)
+    nblk = pl.cdiv(rows, br)
+    affine = gamma is not None
+    has_beta = beta is not None
+
+    in_specs = [
+        pl.BlockSpec((br, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((br, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [dy2, saved2]
+    if affine:
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(gamma.reshape(1, hidden))
+    if has_beta:
+        in_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(beta.reshape(1, hidden))
+    in_specs += [
+        pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+    args += [mean, invvar]
+
+    out_specs = [pl.BlockSpec((br, hidden), lambda i: (i, 0),
+                              memory_space=pltpu.VMEM)]
+    out_shape = [jax.ShapeDtypeStruct((rows, hidden), dy2.dtype)]
+    if affine:
+        out_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shape.append(jax.ShapeDtypeStruct((1, hidden), _f32))
+        if has_beta:
+            out_specs.append(pl.BlockSpec((1, hidden), lambda i: (0, 0),
+                                          memory_space=pltpu.VMEM))
+            out_shape.append(jax.ShapeDtypeStruct((1, hidden), _f32))
+
+    def kernel(*refs):
+        i = 0
+        dy_ref = refs[i]; i += 1
+        s_ref = refs[i]; i += 1
+        g_ref = b_ref = None
+        if affine:
+            g_ref = refs[i]; i += 1
+        if has_beta:
+            b_ref = refs[i]; i += 1
+        mean_ref = refs[i]; i += 1
+        invvar_ref = refs[i]; i += 1
+        dx_ref = refs[i]; i += 1
+        dgp_ref = dbp_ref = None
+        if affine:
+            dgp_ref = refs[i]; i += 1
+        if has_beta:
+            dbp_ref = refs[i]; i += 1
+        _ln_bwd_kernel(dy_ref, s_ref, g_ref, b_ref, mean_ref, invvar_ref,
+                       dx_ref, dgp_ref, dbp_ref, rms=rms, affine=affine,
+                       memory_efficient=memory_efficient)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*args)
+    dx = out[0][:true_rows]
+    dgamma = dbeta = None
+    if affine:
+        dgamma = out[1].reshape(hidden)
+        if has_beta:
+            dbeta = out[2].reshape(hidden)
+    return dx, dgamma, dbeta

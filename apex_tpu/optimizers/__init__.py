@@ -1,0 +1,12 @@
+"""Optimizer family — parity with ``apex/optimizers/__init__.py:1-6`` plus the
+contrib distributed (ZeRO) optimizers."""
+
+from apex_tpu.optimizers.fused_adam import FusedAdam, FusedAdamW  # noqa: F401
+from apex_tpu.optimizers.fused_sgd import FusedSGD  # noqa: F401
+from apex_tpu.optimizers.fused_lamb import FusedLAMB  # noqa: F401
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad  # noqa: F401
+from apex_tpu.optimizers.fused_adagrad import FusedAdagrad  # noqa: F401
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (  # noqa: F401
+    FusedMixedPrecisionLamb,
+)
+from apex_tpu.optimizers import functional  # noqa: F401

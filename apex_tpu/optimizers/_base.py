@@ -1,0 +1,116 @@
+"""Shared machinery for the stateful apex-style optimizer frontends.
+
+The reference optimizers are ``torch.optim.Optimizer`` subclasses holding
+mutable state and exposing ``.step()`` (e.g. apex/optimizers/fused_adam.py:146).
+The TPU equivalents keep ALL state (params, moments, step counter) as device
+arrays inside one jitted, donated update — so ``.step(grads)`` is a single
+compiled program with no host sync ("capturable" by construction,
+fused_adam.py:234-308).
+
+Two usage styles:
+- stateful: ``opt = FusedAdam(params); params = opt.step(grads)``
+- functional: each optimizer also exposes its pure update in
+  :mod:`apex_tpu.optimizers.functional` for use inside user jit/pjit loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FusedOptimizerBase:
+    """Base for stateful frontends. Subclasses set ``self._update_fn`` (pure:
+    (params, grads, state, step, lr, inv_scale, found_inf) -> (params, state))
+    and build initial ``self.state`` (a pytree dict)."""
+
+    def __init__(self, params: Any, lr: float):
+        # own a copy: step() donates the param buffers into the jitted update,
+        # which must not invalidate arrays the caller still holds
+        self._params = jax.tree_util.tree_map(
+            lambda p: jnp.array(p, copy=True), params)
+        self._lr = lr
+        self._step = jnp.zeros((), jnp.int32)
+        self.state: Dict[str, Any] = {}
+        self._jitted: Optional[Callable] = None
+
+    # -- core ---------------------------------------------------------------
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        raise NotImplementedError
+
+    def _stepped_update(self, params, grads, state, prev_step, lr, inv_scale,
+                        found_inf):
+        # the step counter only advances on applied (non-overflow) steps,
+        # matching the reference capturable semantics (fused_adam.py:181:
+        # step incremented only when the overflow buffer is clear)
+        found_inf = jnp.asarray(found_inf, jnp.bool_)
+        step = prev_step + jnp.where(found_inf, 0, 1).astype(jnp.int32)
+        params, state = self._update(params, grads, state, step, lr,
+                                     inv_scale, found_inf)
+        return params, state, step
+
+    def _get_jitted(self):
+        if self._jitted is None:
+            # donate only optimizer state: params are returned to the caller,
+            # who may hold them across steps (state is internal)
+            self._jitted = jax.jit(self._stepped_update, donate_argnums=(2,))
+        return self._jitted
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             inv_scale=1.0, found_inf=False):
+        """Apply one optimizer step; returns (and stores) updated params."""
+        lr_val = jnp.asarray(self._lr if lr is None else lr, jnp.float32)
+        params, state, step = self._get_jitted()(
+            self._params, grads, self.state, self._step, lr_val,
+            jnp.asarray(inv_scale, jnp.float32),
+            jnp.asarray(found_inf, jnp.bool_))
+        self._params, self.state, self._step = params, state, step
+        return params
+
+    # -- torch-optim-compatible surface ------------------------------------
+    @property
+    def parameters(self):
+        return self._params
+
+    @property
+    def param_groups(self):
+        # single-group view for API compatibility
+        return [{"params": jax.tree_util.tree_leaves(self._params),
+                 "lr": self._lr}]
+
+    def zero_grad(self, set_to_none: bool = True):
+        """No-op: grads are function outputs in JAX (kept for API parity)."""
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Checkpointable state (host numpy), ≈ torch ``state_dict``."""
+        return {
+            "step": int(self._step),
+            "lr": self._lr,
+            "state": jax.tree_util.tree_map(np.asarray, self.state),
+            "params": jax.tree_util.tree_map(np.asarray, self._params),
+        }
+
+    def load_state_dict(self, sd: Dict[str, Any]):
+        self._step = jnp.asarray(sd["step"], jnp.int32)
+        self._lr = sd["lr"]
+        self.state = jax.tree_util.tree_map(jnp.asarray, sd["state"])
+        self._params = jax.tree_util.tree_map(jnp.asarray, sd["params"])
+        self._jitted = None
+
+
+def zeros_like_f32(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+
+def scalar_zeros(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda p: jnp.zeros((), jnp.float32), tree)
+
+
+def master_copy(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), tree)

@@ -1,0 +1,298 @@
+"""Pure jittable optimizer updates over pytrees — the math of apex's fused optimizers.
+
+Each function mirrors one CUDA functor from the reference:
+- ``adam_update``      — ``AdamFunctor`` csrc/multi_tensor_adam.cu:24 (mode 0=L2, 1=AdamW)
+- ``sgd_update``       — ``SGDFunctor`` csrc/multi_tensor_sgd_kernel.cu (momentum,
+  dampening, nesterov, wd before/after momentum)
+- ``lamb_update``      — ``LAMBStage1Functor``/``LAMBStage2Functor``
+  csrc/multi_tensor_lamb.cu (update term + per-tensor trust ratio)
+- ``novograd_update``  — ``NovoGradFunctor`` csrc/multi_tensor_novograd.cu
+  (per-tensor 2nd-moment norm)
+- ``adagrad_update``   — ``AdagradFunctor`` csrc/multi_tensor_adagrad.cu
+
+Conventions shared with the reference kernels: all math in fp32 regardless of
+storage dtype; a ``found_inf`` flag turns the whole update into a no-op
+(the ``noop_flag`` of csrc/multi_tensor_apply.cuh); grads may carry a loss
+scale, removed via ``inv_scale``. When a ``master`` tree (fp32) is given the
+master is updated and params are its low-precision cast (amp O2 semantics).
+
+Under one ``jax.jit`` these tree_maps trace into a single XLA program whose
+elementwise chains fuse — the TPU analog of one multi_tensor_apply launch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.functional import multi_tensor_l2norm
+
+_f32 = jnp.float32
+
+
+def _keep(noop, old, new):
+    return jax.tree_util.tree_map(
+        lambda o, n: jnp.where(noop, o.astype(_f32), n).astype(o.dtype)
+        if o.dtype != _f32 else jnp.where(noop, o, n), old, new)
+
+
+def _prep(found_inf):
+    return jnp.asarray(found_inf, jnp.bool_)
+
+
+def adam_update(params: Any, grads: Any, exp_avg: Any, exp_avg_sq: Any, *,
+                step, lr, beta1: float = 0.9, beta2: float = 0.999,
+                eps: float = 1e-8, weight_decay: float = 0.0,
+                adam_w_mode: bool = True, bias_correction: bool = True,
+                inv_scale=1.0, found_inf=False,
+                master: Optional[Any] = None):
+    """Fused Adam/AdamW tree update. Returns ``(params, m, v[, master])``."""
+    noop = _prep(found_inf)
+    stepf = jnp.asarray(step, _f32)
+    lr = jnp.asarray(lr, _f32)
+    inv_scale = jnp.asarray(inv_scale, _f32)
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(_f32(beta1), stepf)
+        bc2 = 1.0 - jnp.power(_f32(beta2), stepf)
+    else:
+        bc1 = bc2 = _f32(1.0)
+
+    src = master if master is not None else params
+
+    def _leaf(p, g, m, v):
+        p32 = p.astype(_f32)
+        g32 = g.astype(_f32) * inv_scale
+        m32 = m.astype(_f32)
+        v32 = v.astype(_f32)
+        if not adam_w_mode:
+            g32 = g32 + weight_decay * p32
+        m_new = beta1 * m32 + (1.0 - beta1) * g32
+        v_new = beta2 * v32 + (1.0 - beta2) * g32 * g32
+        upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if adam_w_mode:
+            upd = upd + weight_decay * p32
+        return p32 - lr * upd, m_new, v_new
+
+    new = jax.tree_util.tree_map(_leaf, src, grads, exp_avg, exp_avg_sq)
+    p_new = jax.tree_util.tree_map(lambda t: t[0], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    m_new = jax.tree_util.tree_map(lambda t: t[1], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    v_new = jax.tree_util.tree_map(lambda t: t[2], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    m_out = _keep(noop, exp_avg, m_new)
+    v_out = _keep(noop, exp_avg_sq, v_new)
+    if master is not None:
+        master_out = _keep(noop, master, p_new)
+        p_out = jax.tree_util.tree_map(
+            lambda p, pm: jnp.where(noop, p.astype(_f32),
+                                    pm.astype(_f32)).astype(p.dtype),
+            params, master_out)
+        return p_out, m_out, v_out, master_out
+    p_out = _keep(noop, params, p_new)
+    return p_out, m_out, v_out
+
+
+def sgd_update(params: Any, grads: Any, momentum_buf: Any, *,
+               lr, momentum: float = 0.0, dampening: float = 0.0,
+               weight_decay: float = 0.0, nesterov: bool = False,
+               wd_after_momentum: bool = False, first_step=False,
+               inv_scale=1.0, found_inf=False, master: Optional[Any] = None):
+    """Fused SGD tree update (csrc/multi_tensor_sgd_kernel.cu ``SGDFunctor``).
+
+    Returns ``(params, momentum_buf[, master])``. ``first_step`` may be a traced
+    bool — on the first step the momentum buffer is initialized to the
+    (wd-adjusted) gradient, matching torch/apex semantics.
+    """
+    noop = _prep(found_inf)
+    lr = jnp.asarray(lr, _f32)
+    inv_scale = jnp.asarray(inv_scale, _f32)
+    first = jnp.asarray(first_step, jnp.bool_)
+    src = master if master is not None else params
+
+    def _leaf(p, g, b):
+        p32 = p.astype(_f32)
+        g32 = g.astype(_f32) * inv_scale
+        b32 = b.astype(_f32)
+        if weight_decay != 0.0 and not wd_after_momentum:
+            g32 = g32 + weight_decay * p32
+        if momentum != 0.0:
+            b_new = jnp.where(first, g32,
+                              momentum * b32 + (1.0 - dampening) * g32)
+            d = g32 + momentum * b_new if nesterov else b_new
+        else:
+            b_new = b32
+            d = g32
+        if weight_decay != 0.0 and wd_after_momentum:
+            d = d + weight_decay * p32
+        return p32 - lr * d, b_new
+
+    new = jax.tree_util.tree_map(_leaf, src, grads, momentum_buf)
+    p_new = jax.tree_util.tree_map(lambda t: t[0], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    b_new = jax.tree_util.tree_map(lambda t: t[1], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    b_out = _keep(noop, momentum_buf, b_new)
+    if master is not None:
+        master_out = _keep(noop, master, p_new)
+        p_out = jax.tree_util.tree_map(
+            lambda p, pm: jnp.where(noop, p.astype(_f32),
+                                    pm.astype(_f32)).astype(p.dtype),
+            params, master_out)
+        return p_out, b_out, master_out
+    return _keep(noop, params, p_new), b_out
+
+
+def lamb_update(params: Any, grads: Any, exp_avg: Any, exp_avg_sq: Any, *,
+                step, lr, beta1: float = 0.9, beta2: float = 0.999,
+                eps: float = 1e-6, weight_decay: float = 0.01,
+                bias_correction: bool = True, grad_averaging: bool = True,
+                max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+                adam_w_mode: bool = True, inv_scale=1.0, found_inf=False):
+    """Fused LAMB tree update (two-phase like apex/optimizers/fused_lamb.py:145-242):
+    global grad-norm clip, Adam-style update term, per-tensor trust ratio.
+
+    Returns ``(params, m, v, global_grad_norm)``.
+    """
+    noop = _prep(found_inf)
+    stepf = jnp.asarray(step, _f32)
+    lr = jnp.asarray(lr, _f32)
+    inv_scale = jnp.asarray(inv_scale, _f32)
+
+    grads32 = jax.tree_util.tree_map(
+        lambda g: g.astype(_f32) * inv_scale, grads)
+    gnorm, _ = multi_tensor_l2norm(grads32)
+    # clip global grad norm (fused_lamb.py:193-206: clip_global_grad_norm)
+    if max_grad_norm is not None and max_grad_norm > 0:
+        clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
+    else:
+        clip = _f32(1.0)
+
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(_f32(beta1), stepf)
+        bc2 = 1.0 - jnp.power(_f32(beta2), stepf)
+    else:
+        bc1 = bc2 = _f32(1.0)
+
+    def _leaf(p, g, m, v):
+        p32 = p.astype(_f32)
+        g32 = g / clip
+        if not adam_w_mode:
+            g32 = g32 + weight_decay * p32
+        m_new = beta1 * m.astype(_f32) + beta3 * g32
+        v_new = beta2 * v.astype(_f32) + (1.0 - beta2) * g32 * g32
+        upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+        if adam_w_mode and weight_decay != 0.0:
+            upd = upd + weight_decay * p32
+        # trust ratio (LAMBStage2Functor): ratio = w_norm/u_norm when both > 0
+        w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+        u_norm = jnp.sqrt(jnp.sum(upd * upd))
+        if use_nvlamb:
+            ratio = jnp.where(u_norm > 0, w_norm / u_norm, 1.0)
+        else:
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p32 - lr * ratio * upd, m_new, v_new
+
+    new = jax.tree_util.tree_map(_leaf, params, grads32, exp_avg, exp_avg_sq)
+    p_new = jax.tree_util.tree_map(lambda t: t[0], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    m_new = jax.tree_util.tree_map(lambda t: t[1], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    v_new = jax.tree_util.tree_map(lambda t: t[2], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return (_keep(noop, params, p_new), _keep(noop, exp_avg, m_new),
+            _keep(noop, exp_avg_sq, v_new), gnorm)
+
+
+def novograd_update(params: Any, grads: Any, exp_avg: Any, exp_avg_sq: Any, *,
+                    step, lr, beta1: float = 0.95, beta2: float = 0.98,
+                    eps: float = 1e-8, weight_decay: float = 0.0,
+                    grad_averaging: bool = False, bias_correction: bool = False,
+                    norm_type: int = 2, init_zero: bool = False,
+                    inv_scale=1.0, found_inf=False):
+    """Fused NovoGrad tree update (csrc/multi_tensor_novograd.cu).
+
+    ``exp_avg_sq`` is a per-tensor scalar tree (the per-layer 2nd-moment norm,
+    fused_novograd.py:126+). Returns ``(params, m, v)``.
+    """
+    noop = _prep(found_inf)
+    stepf = jnp.asarray(step, _f32)
+    lr = jnp.asarray(lr, _f32)
+    inv_scale = jnp.asarray(inv_scale, _f32)
+    first = stepf <= 1.0
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = 1.0 - jnp.power(_f32(beta1), stepf)
+        bc2 = 1.0 - jnp.power(_f32(beta2), stepf)
+    else:
+        bc1 = bc2 = _f32(1.0)
+
+    def _leaf(p, g, m, v):
+        p32 = p.astype(_f32)
+        g32 = g.astype(_f32) * inv_scale
+        gnorm_sq = jnp.sum(g32 * g32)
+        if norm_type == 0:
+            gn = jnp.max(jnp.abs(g32))
+        else:
+            gn = jnp.sqrt(gnorm_sq)
+        if init_zero:
+            v_new = beta2 * v.astype(_f32) + (1.0 - beta2) * gn * gn \
+                if norm_type == 2 else jnp.maximum(beta2 * v.astype(_f32), gn)
+            v_new = jnp.where(first, (1.0 - beta2) * gn * gn, v_new) \
+                if norm_type == 2 else v_new
+        else:
+            v_upd = beta2 * v.astype(_f32) + (1.0 - beta2) * gn * gn \
+                if norm_type == 2 else jnp.maximum(beta2 * v.astype(_f32), gn)
+            v_new = jnp.where(first, gn * gn if norm_type == 2 else gn, v_upd)
+        denom = jnp.sqrt(v_new / bc2) + eps if norm_type == 2 \
+            else v_new / bc2 + eps
+        gg = g32 / denom
+        if weight_decay != 0.0:
+            gg = gg + weight_decay * p32
+        m_new = beta1 * m.astype(_f32) + beta3 * gg
+        upd = m_new / bc1
+        return p32 - lr * upd, m_new, v_new
+
+    new = jax.tree_util.tree_map(_leaf, params, grads, exp_avg, exp_avg_sq)
+    p_new = jax.tree_util.tree_map(lambda t: t[0], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    m_new = jax.tree_util.tree_map(lambda t: t[1], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    v_new = jax.tree_util.tree_map(lambda t: t[2], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return (_keep(noop, params, p_new), _keep(noop, exp_avg, m_new),
+            _keep(noop, exp_avg_sq, v_new))
+
+
+def adagrad_update(params: Any, grads: Any, state_sum: Any, *,
+                   lr, eps: float = 1e-10, weight_decay: float = 0.0,
+                   adagrad_w_mode: bool = False, inv_scale=1.0,
+                   found_inf=False):
+    """Fused Adagrad tree update (csrc/multi_tensor_adagrad.cu ``AdagradFunctor``).
+
+    Returns ``(params, state_sum)``.
+    """
+    noop = _prep(found_inf)
+    lr = jnp.asarray(lr, _f32)
+    inv_scale = jnp.asarray(inv_scale, _f32)
+
+    def _leaf(p, g, h):
+        p32 = p.astype(_f32)
+        g32 = g.astype(_f32) * inv_scale
+        if not adagrad_w_mode and weight_decay != 0.0:
+            g32 = g32 + weight_decay * p32
+        h_new = h.astype(_f32) + g32 * g32
+        upd = g32 / (jnp.sqrt(h_new) + eps)
+        if adagrad_w_mode and weight_decay != 0.0:
+            upd = upd + weight_decay * p32
+        return p32 - lr * upd, h_new
+
+    new = jax.tree_util.tree_map(_leaf, params, grads, state_sum)
+    p_new = jax.tree_util.tree_map(lambda t: t[0], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    h_new = jax.tree_util.tree_map(lambda t: t[1], new,
+                                   is_leaf=lambda t: isinstance(t, tuple))
+    return _keep(noop, params, p_new), _keep(noop, state_sum, h_new)

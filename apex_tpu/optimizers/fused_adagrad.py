@@ -1,0 +1,30 @@
+"""FusedAdagrad — TPU equivalent of ``apex/optimizers/fused_adagrad.py`` (:75 step).
+
+``adagrad_w_mode`` gives decoupled weight decay (csrc/multi_tensor_adagrad.cu).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from apex_tpu.optimizers._base import FusedOptimizerBase, zeros_like_f32
+from apex_tpu.optimizers.functional import adagrad_update
+
+
+class FusedAdagrad(FusedOptimizerBase):
+    def __init__(self, params: Any, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0, adagrad_w_mode: bool = False,
+                 set_grad_none: bool = True):
+        super().__init__(params, lr)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+        self.state = {"sum": zeros_like_f32(params)}
+
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        p, h = adagrad_update(
+            params, grads, state["sum"], lr=lr, eps=self.eps,
+            weight_decay=self.weight_decay,
+            adagrad_w_mode=self.adagrad_w_mode, inv_scale=inv_scale,
+            found_inf=found_inf)
+        return p, {"sum": h}

@@ -1,0 +1,132 @@
+"""FusedAdam — TPU equivalent of ``apex/optimizers/fused_adam.py`` (:146 step).
+
+Implements Adam/AdamW with: ``adam_w_mode`` (multi_tensor_adam.cu:16-19),
+``bias_correction``, optional fp32 ``master_weights`` for low-precision params
+(fused_adam.py:104-115), capturable semantics by construction (everything is
+traced, :234-308), and a ``found_inf``/``inv_scale`` no-op channel replacing the
+GradScaler/noop_flag plumbing.
+
+Two execution paths:
+- tree path (default): leaf-wise fused update, XLA fuses the elementwise chains
+  (see optimizers/functional.py:adam_update).
+- flat Pallas path (``use_flat=True``): params/grads/state packed into one
+  contiguous 128-lane-aligned buffer per dtype group and updated by the single
+  Pallas kernel in ops/pallas/fused_adam_kernel.py — the analog of one
+  multi_tensor_apply launch over the whole parameter list, and the layout the
+  distributed optimizers shard.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._base import (FusedOptimizerBase, master_copy,
+                                       zeros_like_f32)
+from apex_tpu.optimizers.functional import adam_update
+from apex_tpu.ops.pallas.fused_adam_kernel import (ADAM_MODE_ADAMW,
+                                                   ADAM_MODE_L2,
+                                                   fused_adam_flat)
+from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
+
+
+class FusedAdam(FusedOptimizerBase):
+    def __init__(self, params: Any, lr: float = 1e-3,
+                 bias_correction: bool = True, betas=(0.9, 0.999),
+                 eps: float = 1e-8, adam_w_mode: bool = True,
+                 weight_decay: float = 0.0, amsgrad: bool = False,
+                 capturable: bool = True, master_weights: bool = False,
+                 use_flat: bool = False):
+        if amsgrad:
+            # parity with the reference: fused_adam.py:124 raises the same way
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        super().__init__(params, lr)
+        del capturable  # always-on under jit; kept for signature parity
+        self.betas = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.master_weights = master_weights
+        self.use_flat = use_flat
+
+        if use_flat:
+            # pack params into one flat fp32-state buffer (Pallas path)
+            self._spec = flat_spec(params)
+            self._flat_p = flatten(params, self._spec,
+                                   dtype=jnp.float32 if master_weights
+                                   else None, pad_to=1024)
+            self.state = {
+                "m": jnp.zeros_like(self._flat_p, dtype=jnp.float32),
+                "v": jnp.zeros_like(self._flat_p, dtype=jnp.float32),
+            }
+        else:
+            self.state = {
+                "m": zeros_like_f32(params),
+                "v": zeros_like_f32(params),
+            }
+            if master_weights:
+                self.state["master"] = master_copy(params)
+
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        out = adam_update(
+            params, grads, state["m"], state["v"], step=step, lr=lr,
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, inv_scale=inv_scale,
+            found_inf=found_inf, master=state.get("master"))
+        if self.master_weights:
+            p, m, v, mst = out
+            return p, {"m": m, "v": v, "master": mst}
+        p, m, v = out
+        return p, {"m": m, "v": v}
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             inv_scale=1.0, found_inf=False):
+        if not self.use_flat:
+            return super().step(grads, lr=lr, inv_scale=inv_scale,
+                                found_inf=found_inf)
+        # flat Pallas path; step only advances on applied (non-overflow) steps
+        self._step = self._step + jnp.where(
+            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
+        flat_g = flatten(grads, self._spec, dtype=self._flat_p.dtype,
+                         pad_to=self._flat_p.size)
+        mode = ADAM_MODE_ADAMW if self.adam_w_mode else ADAM_MODE_L2
+        p, m, v = fused_adam_flat(
+            self._flat_p, flat_g, self.state["m"], self.state["v"],
+            lr=jnp.asarray(self._lr if lr is None else lr, jnp.float32),
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay, step=self._step, mode=mode,
+            bias_correction=self.bias_correction, inv_scale=inv_scale,
+            found_inf=found_inf)
+        self._flat_p, self.state["m"], self.state["v"] = p, m, v
+        self._params = unflatten(p, self._spec)
+        return self._params
+
+    def state_dict(self):
+        sd = super().state_dict()
+        if self.use_flat:
+            import numpy as np
+            sd["flat_p"] = np.asarray(self._flat_p)
+        return sd
+
+    def load_state_dict(self, sd):
+        super().load_state_dict(sd)
+        if self.use_flat:
+            if "flat_p" in sd:
+                self._flat_p = jnp.asarray(sd["flat_p"])
+            else:
+                # checkpoint from the tree path: rebuild the flat buffer
+                self._flat_p = flatten(self._params, self._spec,
+                                       dtype=self._flat_p.dtype,
+                                       pad_to=1024)
+
+
+class FusedAdamW(FusedAdam):
+    """Convenience alias with decoupled weight decay on by default."""
+
+    def __init__(self, params, lr: float = 1e-3, **kw):
+        kw.setdefault("adam_w_mode", True)
+        super().__init__(params, lr=lr, **kw)

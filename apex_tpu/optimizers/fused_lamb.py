@@ -1,0 +1,47 @@
+"""FusedLAMB — TPU equivalent of ``apex/optimizers/fused_lamb.py`` (:114 step).
+
+Two-phase semantics of the reference preserved: fused global grad-norm
+(multi_tensor_l2norm, fused_lamb.py:145-158) feeding a clip, then the LAMB
+update with per-tensor trust ratios (csrc/multi_tensor_lamb.cu stage1/stage2).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from apex_tpu.optimizers._base import FusedOptimizerBase, zeros_like_f32
+from apex_tpu.optimizers.functional import lamb_update
+
+
+class FusedLAMB(FusedOptimizerBase):
+    def __init__(self, params: Any, lr: float = 1e-3,
+                 bias_correction: bool = True, betas=(0.9, 0.999),
+                 eps: float = 1e-6, weight_decay: float = 0.01,
+                 amsgrad: bool = False, adam_w_mode: bool = True,
+                 grad_averaging: bool = True, max_grad_norm: float = 1.0,
+                 use_nvlamb: bool = False):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        super().__init__(params, lr)
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.state = {"m": zeros_like_f32(params), "v": zeros_like_f32(params)}
+        self.last_grad_norm = None
+
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        p, m, v, gnorm = lamb_update(
+            params, grads, state["m"], state["v"], step=step, lr=lr,
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay,
+            bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging,
+            max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
+            adam_w_mode=self.adam_w_mode, inv_scale=inv_scale,
+            found_inf=found_inf)
+        return p, {"m": m, "v": v}

@@ -1,0 +1,63 @@
+"""FusedMixedPrecisionLamb — TPU equivalent of
+``apex/optimizers/fused_mixed_precision_lamb.py``.
+
+LAMB with full-precision (fp32) optimizer state and master weights while the
+model params are low precision (bf16/fp16); device-tensor ``step``/``lr`` and
+GradScaler-awareness (:166) are inherent under jit. Uses the ``*_mp`` kernel
+semantics (multi_tensor_l2norm_mp / multi_tensor_lamb_mp, :55-58): norms and
+update math on fp32 master state, params written as the low-precision cast.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._base import (FusedOptimizerBase, master_copy,
+                                       zeros_like_f32)
+from apex_tpu.optimizers.functional import lamb_update
+
+
+class FusedMixedPrecisionLamb(FusedOptimizerBase):
+    def __init__(self, params: Any, lr: float = 1e-3, step: int = 0,
+                 bias_correction: bool = True, betas=(0.9, 0.999),
+                 eps: float = 1e-6, weight_decay: float = 0.01,
+                 amsgrad: bool = False, grad_averaging: bool = True,
+                 max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+                 reduced_precision_dtype=jnp.bfloat16):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedMixedPrecisionLamb does not support the AMSGrad variant.")
+        super().__init__(params, lr)
+        self._step = jnp.asarray(step, jnp.int32)
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+        self.reduced_precision_dtype = reduced_precision_dtype
+        # model params live in reduced precision; state + master in fp32
+        self._params = jax.tree_util.tree_map(
+            lambda p: p.astype(reduced_precision_dtype), params)
+        self.state = {
+            "m": zeros_like_f32(params),
+            "v": zeros_like_f32(params),
+            "master": master_copy(params),
+        }
+
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        pm, m, v, gnorm = lamb_update(
+            state["master"], grads, state["m"], state["v"], step=step, lr=lr,
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay,
+            bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging,
+            max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
+            inv_scale=inv_scale, found_inf=found_inf)
+        p = jax.tree_util.tree_map(
+            lambda x: x.astype(self.reduced_precision_dtype), pm)
+        return p, {"m": m, "v": v, "master": pm}

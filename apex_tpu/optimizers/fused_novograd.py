@@ -1,0 +1,53 @@
+"""FusedNovoGrad — TPU equivalent of ``apex/optimizers/fused_novograd.py`` (:126 step).
+
+Per-tensor second-moment norm (``exp_avg_sq`` is one scalar per parameter
+tensor), ``norm_type`` 0 (inf) / 2 (L2), ``init_zero`` initialization —
+mirroring csrc/multi_tensor_novograd.cu ``NovoGradFunctor``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from apex_tpu.optimizers._base import (FusedOptimizerBase, scalar_zeros,
+                                       zeros_like_f32)
+from apex_tpu.optimizers.functional import novograd_update
+
+
+class FusedNovoGrad(FusedOptimizerBase):
+    def __init__(self, params: Any, lr: float = 1e-3,
+                 bias_correction: bool = True, betas=(0.95, 0.98),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 amsgrad: bool = False, reg_inside_moment: bool = False,
+                 grad_averaging: bool = True, norm_type: int = 2,
+                 init_zero: bool = False, set_grad_none: bool = True):
+        if amsgrad:
+            raise RuntimeError(
+                "FusedNovoGrad does not support the AMSGrad variant.")
+        super().__init__(params, lr)
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.grad_averaging = grad_averaging
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+        self.state = {"m": zeros_like_f32(params),
+                      "v": scalar_zeros(params)}
+
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        p, m, v = novograd_update(
+            params, grads, state["m"], state["v"], step=step, lr=lr,
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay,
+            grad_averaging=self.grad_averaging,
+            bias_correction=self.bias_correction, norm_type=self.norm_type,
+            init_zero=self.init_zero, inv_scale=inv_scale,
+            found_inf=found_inf)
+        return p, {"m": m, "v": v}
+
+    def load_state_dict(self, sd):
+        # parity note: the reference re-materializes per-group norm tensors on
+        # load (fused_novograd.py:118); here v is already a per-tensor scalar
+        # tree restored directly.
+        super().load_state_dict(sd)

@@ -1,0 +1,52 @@
+"""FusedSGD — TPU equivalent of ``apex/optimizers/fused_sgd.py``.
+
+SGD with momentum, dampening, nesterov; ``wd_after_momentum`` and
+``materialize_master_grads`` flags mirror the amp-O2-style master-weight
+training knobs of the reference (csrc/multi_tensor_sgd_kernel.cu depths 2-4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._base import (FusedOptimizerBase, master_copy,
+                                       zeros_like_f32)
+from apex_tpu.optimizers.functional import sgd_update
+
+
+class FusedSGD(FusedOptimizerBase):
+    def __init__(self, params: Any, lr: float, momentum: float = 0.0,
+                 dampening: float = 0.0, weight_decay: float = 0.0,
+                 nesterov: bool = False, wd_after_momentum: bool = False,
+                 materialize_master_grads: bool = True,
+                 master_weights: bool = False):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        super().__init__(params, lr)
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+        self.materialize_master_grads = materialize_master_grads
+        self.master_weights = master_weights
+        self.state = {"momentum_buffer": zeros_like_f32(params)}
+        if master_weights:
+            self.state["master"] = master_copy(params)
+
+    def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
+        out = sgd_update(
+            params, grads, state["momentum_buffer"], lr=lr,
+            momentum=self.momentum, dampening=self.dampening,
+            weight_decay=self.weight_decay, nesterov=self.nesterov,
+            wd_after_momentum=self.wd_after_momentum,
+            first_step=(step == 1), inv_scale=inv_scale,
+            found_inf=found_inf, master=state.get("master"))
+        if self.master_weights:
+            p, buf, mst = out
+            return p, {"momentum_buffer": buf, "master": mst}
+        p, buf = out
+        return p, {"momentum_buffer": buf}

@@ -1,0 +1,13 @@
+"""Distributed utilities — TPU equivalent of the removed ``apex.parallel``
+(DDP + SyncBatchNorm) and the contrib comm machinery, over XLA collectives."""
+
+from apex_tpu.parallel.mesh import get_mesh, make_mesh  # noqa: F401
+from apex_tpu.parallel.ddp import (  # noqa: F401
+    DistributedDataParallel,
+    bucketed_allreduce,
+    allreduce_grads,
+)
+from apex_tpu.parallel.sync_batch_norm import (  # noqa: F401
+    SyncBatchNorm,
+    sync_batch_norm_stats,
+)

@@ -1,0 +1,31 @@
+"""Mesh helpers — the rendezvous layer.
+
+Reference analog: NCCL bootstrap (``apex/contrib/csrc/nccl_p2p/nccl_p2p.cpp:20-22``
+broadcasting ``ncclUniqueId``) and c10d process groups. On TPU the fabric is the
+device mesh: ``jax.sharding.Mesh`` over ICI (+DCN for multislice), with
+``jax.distributed.initialize`` as the multi-host rendezvous.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
+              devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(axis_sizes))
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def get_mesh(data_axis: str = "data", devices=None) -> Mesh:
+    """1-D data-parallel mesh over all local devices (DDP default)."""
+    devices = devices if devices is not None else jax.devices()
+    return make_mesh([len(devices)], [data_axis], devices)

@@ -1,0 +1,133 @@
+"""SyncBatchNorm — TPU equivalent of the ``syncbn`` kernels + removed
+``apex.parallel.SyncBatchNorm`` frontend.
+
+Reference: ``csrc/welford.cu`` — per-GPU Welford stats (``welford_kernel``
+:218), cross-process parallel merge after all-gather
+(``welford_kernel_parallel`` :502), BN fwd/bwd (:277,:334) with NCHW and
+channels-last paths and fused ReLU backward (:565). Python spec:
+``tests/distributed/synced_batchnorm/*``.
+
+TPU design: local reduction + ``all_gather`` of per-device (mean, m2, count)
+merged with the numerically-stable Chan/Welford pairwise formula — the exact
+analog of ``welford_kernel_parallel``. Differentiation through the collectives
+gives the cross-replica backward for free (psum transpose = psum), replacing
+the handwritten ``batchnorm_backward_kernel``. Layout (NCHW vs NHWC) is an
+``axis`` argument — XLA handles both without separate kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def _welford_merge(mean_a, m2_a, n_a, mean_b, m2_b, n_b):
+    """Chan et al. pairwise merge (welford.cu:502 ``welford_kernel_parallel``)."""
+    n = n_a + n_b
+    delta = mean_b - mean_a
+    safe_n = jnp.where(n > 0, n, 1.0)
+    mean = mean_a + delta * n_b / safe_n
+    m2 = m2_a + m2_b + delta * delta * n_a * n_b / safe_n
+    return mean, m2, n
+
+
+def sync_batch_norm_stats(x: jax.Array, reduce_axes: Sequence[int],
+                          axis_name: Optional[str] = None):
+    """Cross-replica Welford mean/var over ``reduce_axes`` (+ the device axis).
+
+    Returns ``(mean, var_biased, count_total)`` in fp32, shaped like the
+    non-reduced (channel) dims.
+    """
+    x32 = x.astype(_f32)
+    n_local = 1
+    for a in reduce_axes:
+        n_local *= x.shape[a]
+    n_local = jnp.asarray(n_local, _f32)
+    mean_l = jnp.mean(x32, axis=tuple(reduce_axes))
+    var_l = jnp.mean(
+        jnp.square(x32 - jnp.expand_dims(mean_l, tuple(reduce_axes))),
+        axis=tuple(reduce_axes))
+    m2_l = var_l * n_local
+
+    if axis_name is None:
+        return mean_l, var_l, n_local
+
+    # gather per-device stats and merge pairwise (stable, order-independent
+    # up to fp error — same structure as the reference's parallel merge)
+    means = jax.lax.all_gather(mean_l, axis_name)   # (world, C)
+    m2s = jax.lax.all_gather(m2_l, axis_name)
+    world = means.shape[0]
+    counts = jnp.full((world,), n_local, _f32)
+
+    def body(carry, xs):
+        mean_a, m2_a, n_a = carry
+        mean_b, m2_b, n_b = xs
+        return _welford_merge(mean_a, m2_a, n_a, mean_b, m2_b, n_b), None
+
+    (mean, m2, n), _ = jax.lax.scan(
+        body, (means[0], m2s[0], counts[0]),
+        (means[1:], m2s[1:], counts[1:]))
+    return mean, m2 / n, n
+
+
+class SyncBatchNorm(nn.Module):
+    """flax module ≈ ``apex.parallel.SyncBatchNorm`` (README.md:76-81 surface).
+
+    ``axis_name=None`` degrades to plain BatchNorm (single-device).
+    ``channel_axis`` selects NCHW (1) or NHWC (-1) — both welford.cu layout
+    variants. ``fuse_relu`` mirrors the fused ReLU path (:565); on TPU XLA
+    fuses the activation into the normalization loop automatically.
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = "data"
+    channel_axis: int = -1
+    fuse_relu: bool = False
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        ca = self.channel_axis % x.ndim
+        reduce_axes = tuple(a for a in range(x.ndim) if a != ca)
+        shape_bc = tuple(self.num_features if a == ca else 1
+                         for a in range(x.ndim))
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((self.num_features,), _f32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((self.num_features,), _f32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # during init the mesh axis may not be bound yet → local stats
+            axis = None if self.is_initializing() else self.axis_name
+            mean, var, count = sync_batch_norm_stats(x, reduce_axes, axis)
+            if self.track_running_stats and not self.is_initializing():
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = ((1 - self.momentum) * ra_mean.value
+                                 + self.momentum * mean)
+                ra_var.value = ((1 - self.momentum) * ra_var.value
+                                + self.momentum * unbiased)
+
+        y = (x.astype(_f32) - mean.reshape(shape_bc)) * jax.lax.rsqrt(
+            var.reshape(shape_bc) + self.eps)
+        if self.affine:
+            weight = self.param("weight", nn.initializers.ones,
+                                (self.num_features,), self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.num_features,), self.param_dtype)
+            y = y * weight.reshape(shape_bc).astype(_f32) \
+                + bias.reshape(shape_bc).astype(_f32)
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)

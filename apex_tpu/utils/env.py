@@ -1,0 +1,22 @@
+"""Backend detection helpers.
+
+Pallas TPU kernels run compiled on TPU and in interpreter mode everywhere else
+(CPU test meshes, ``xla_force_host_platform_device_count`` virtual devices).
+"""
+
+import functools
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def platform_is_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - no devices at all
+        return False
+
+
+def interpret_default() -> bool:
+    """Whether pallas_call should run in interpret mode (True off-TPU)."""
+    return not platform_is_tpu()

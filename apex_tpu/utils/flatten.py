@@ -1,0 +1,112 @@
+"""Flatten / unflatten — TPU equivalent of the ``apex_C`` extension.
+
+Reference: ``csrc/flatten_unflatten.cpp:4-13`` (``flatten``/``unflatten`` over
+``torch.utils._flatten_dense_tensors``) — the primitive under flat-bucket DDP
+all-reduce and the ZeRO optimizers' contiguous buffers
+(``apex/contrib/optimizers/distributed_fused_adam.py:1074-1195``).
+
+On TPU the flat buffer is the idiomatic layout for collectives *and* for the
+fused optimizer kernels: one ``psum``/``psum_scatter`` over one contiguous
+array, one Pallas kernel over one contiguous array. We keep offsets 128-lane
+aligned so slices of the flat buffer remain tileable.
+
+The offset/size planning is host-side bookkeeping; a C++ twin of the planner
+lives in ``apex_tpu/_csrc`` (optional native module) — this module is the
+always-available implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANE = 128  # TPU lane width; keep per-leaf offsets aligned to it.
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+@dataclasses.dataclass(frozen=True)
+class FlatSpec:
+    """Static packing plan for a list/pytree of arrays into one flat buffer."""
+
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[Any, ...]
+    offsets: tuple[int, ...]
+    padded_sizes: tuple[int, ...]
+    total_size: int
+    treedef: Any = None
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.shapes)
+
+
+def flat_spec(tensors: Sequence[jax.Array] | Any, align: int = LANE) -> FlatSpec:
+    """Compute the packing plan. Accepts a list or arbitrary pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(tensors)
+    shapes, dtypes, offsets, padded = [], [], [], []
+    off = 0
+    for leaf in leaves:
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        p = _round_up(max(n, 1), align)
+        shapes.append(tuple(leaf.shape))
+        dtypes.append(leaf.dtype)
+        offsets.append(off)
+        padded.append(p)
+        off += p
+    return FlatSpec(
+        shapes=tuple(shapes),
+        dtypes=tuple(dtypes),
+        offsets=tuple(offsets),
+        padded_sizes=tuple(padded),
+        total_size=off,
+        treedef=treedef,
+    )
+
+
+def flatten(tensors: Sequence[jax.Array] | Any, spec: FlatSpec | None = None,
+            dtype=None, pad_to: int | None = None) -> jax.Array:
+    """Pack arrays into one contiguous 1-D buffer (ref csrc/flatten_unflatten.cpp:12).
+
+    All leaves are cast to ``dtype`` (default: dtype of the first leaf). Padding
+    between leaves is zero-filled so norms over the flat buffer are exact.
+    """
+    leaves = jax.tree_util.tree_leaves(tensors)
+    if spec is None:
+        spec = flat_spec(tensors)
+    dtype = dtype or spec.dtypes[0]
+    parts = []
+    for leaf, shape, padded in zip(leaves, spec.shapes, spec.padded_sizes):
+        n = int(np.prod(shape)) if shape else 1
+        v = jnp.ravel(leaf).astype(dtype)
+        if padded != n:
+            v = jnp.pad(v, (0, padded - n))
+        parts.append(v)
+    flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), dtype)
+    total = spec.total_size if pad_to is None else _round_up(spec.total_size, pad_to)
+    if total != flat.size:
+        flat = jnp.pad(flat, (0, total - flat.size))
+    return flat
+
+
+def unflatten(flat: jax.Array, spec: FlatSpec, like: Any = None):
+    """Slice the flat buffer back into the original shapes/dtypes
+    (ref csrc/flatten_unflatten.cpp:13).
+
+    Returns the original pytree structure when the spec was built from a pytree.
+    """
+    out = []
+    for shape, dtype, off, _ in zip(spec.shapes, spec.dtypes, spec.offsets,
+                                    spec.padded_sizes):
+        n = int(np.prod(shape)) if shape else 1
+        piece = jax.lax.dynamic_slice_in_dim(flat, off, n, axis=0)
+        out.append(piece.reshape(shape).astype(dtype))
+    if spec.treedef is not None:
+        return jax.tree_util.tree_unflatten(spec.treedef, out)
+    return out

@@ -1,0 +1,23 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+TPU translation of the reference's multi-process harness
+(``apex/distributed_testing/distributed_test_base.py:24-83`` spawns one process
+per GPU); here multi-device = 8 virtual CPU devices via XLA_FLAGS, with Pallas
+kernels in interpret mode (SURVEY §4 "TPU translation").
+
+Note: the dev image pre-imports jax via a sitecustomize hook with the platform
+pinned to the TPU tunnel, so env vars are too late here — we must switch the
+platform through jax.config before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

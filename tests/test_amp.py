@@ -1,0 +1,90 @@
+"""amp policy + dynamic grad scaler tests (≈ tests/L1 amp cross-product
+semantics, scaled down to unit level; full matrix in test_integration.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+
+class TestPolicy:
+    def test_opt_levels(self):
+        for ol, pdt, cdt in [("O0", jnp.float32, jnp.float32),
+                             ("O1", jnp.float32, jnp.bfloat16),
+                             ("O2", jnp.bfloat16, jnp.bfloat16),
+                             ("O3", jnp.bfloat16, jnp.bfloat16)]:
+            p = amp.Policy.from_opt_level(ol)
+            assert p.param_dtype == pdt and p.compute_dtype == cdt
+        assert amp.Policy.from_opt_level("O2").master_weights
+        assert not amp.Policy.from_opt_level("O3").keep_batchnorm_fp32
+
+    def test_initialize(self):
+        params = {"w": jnp.ones((4, 4))}
+        cast, opt, policy, scaler = amp.initialize(
+            params, None, "O2", loss_scale="dynamic")
+        assert cast["w"].dtype == jnp.bfloat16
+        assert scaler is not None
+
+    def test_static_scaler(self):
+        p = amp.Policy.from_opt_level("O1", loss_scale=128.0)
+        sc = p.make_scaler()
+        st = sc.init()
+        assert float(st.scale) == 128.0
+        st2 = sc.update(st, jnp.bool_(True))
+        assert float(st2.scale) == 128.0  # static: no backoff
+
+
+class TestDynamicGradScaler:
+    def test_full_fp16_flow_jitted(self):
+        """scale → unscale+check → conditional step → scale update, one jit."""
+        scaler = amp.DynamicGradScaler(init_scale=1024.0, growth_interval=2)
+        params = [jnp.ones((8,), jnp.float32)]
+        opt_state = {"m": [jnp.zeros((8,))], "v": [jnp.zeros((8,))]}
+
+        from apex_tpu.optimizers.functional import adam_update
+
+        @jax.jit
+        def train_step(params, opt_state, scaler_state, x):
+            def loss_fn(p):
+                return jnp.sum(p[0] * x)
+
+            loss, grads = jax.value_and_grad(
+                lambda p: scaler.scale(loss_fn(p), scaler_state))(params)
+            grads, found_inf = scaler.unscale(grads, scaler_state)
+            p, m, v = adam_update(params, grads, opt_state["m"],
+                                  opt_state["v"], step=1, lr=1e-2,
+                                  found_inf=found_inf)
+            return p, {"m": m, "v": v}, scaler.update(scaler_state, found_inf), loss
+
+        st = scaler.init()
+        x = jnp.ones((8,))
+        p, s, st, loss = train_step(params, opt_state, st, x)
+        assert float(loss) == 1024.0 * 8.0
+        assert not np.allclose(np.asarray(p[0]), 1.0)  # step applied
+        # now poison the grads via x=inf → found_inf → no step + backoff
+        p2, s2, st2, _ = train_step(p, s, st, jnp.full((8,), jnp.inf))
+        np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(p[0]))
+        assert float(st2.scale) == float(st.scale) * 0.5
+
+    def test_growth(self):
+        scaler = amp.DynamicGradScaler(init_scale=2.0, growth_interval=2)
+        st = scaler.init()
+        st = scaler.update(st, jnp.bool_(False))
+        st = scaler.update(st, jnp.bool_(False))
+        assert float(st.scale) == 4.0
+
+
+class TestGradScalerFacade:
+    def test_step_skips_on_overflow(self):
+        params = [jnp.ones((4,), jnp.float32)]
+        opt = FusedAdam(params, lr=0.1)
+        scaler = amp.GradScaler(init_scale=64.0)
+        bad = [jnp.array([jnp.inf, 1.0, 1.0, 1.0], jnp.float32)]
+        p = scaler.step(opt, bad)
+        np.testing.assert_array_equal(np.asarray(p[0]), np.ones(4))
+        assert scaler.get_scale() == 32.0
+        good = [jnp.full((4,), 64.0)]  # = scale × true grad of 1.0
+        p = scaler.step(opt, good)
+        assert not np.allclose(np.asarray(p[0]), 1.0)

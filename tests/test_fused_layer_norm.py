@@ -1,0 +1,154 @@
+"""LayerNorm/RMSNorm parity — port of tests/L0/run_fused_layer_norm (~30
+parametrizations: fused vs torch.nn.LayerNorm / manual RMS, fp32/bf16,
+affine/no-affine, memory-efficient)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.normalization import (FusedLayerNorm, FusedRMSNorm,
+                                    fused_layer_norm, fused_layer_norm_affine,
+                                    fused_rms_norm, fused_rms_norm_affine,
+                                    manual_rms_norm)
+
+HIDDEN = 256  # lane-friendly → exercises the Pallas kernels (interpret on CPU)
+BATCH = 6
+SEQ = 4
+
+
+def _x(dtype=jnp.float32, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), (BATCH, SEQ, HIDDEN),
+                             dtype)
+
+
+def _wb(dtype=jnp.float32):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+    w = 1.0 + 0.1 * jax.random.normal(k1, (HIDDEN,), dtype)
+    b = 0.1 * jax.random.normal(k2, (HIDDEN,), dtype)
+    return w, b
+
+
+def _torch_ln(x, w, b, eps=1e-5):
+    tx = torch.tensor(np.asarray(x, np.float32), requires_grad=True)
+    ln = torch.nn.LayerNorm(HIDDEN, eps=eps)
+    with torch.no_grad():
+        ln.weight.copy_(torch.tensor(np.asarray(w, np.float32)))
+        ln.bias.copy_(torch.tensor(np.asarray(b, np.float32)))
+    y = ln(tx)
+    return tx, ln, y
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("mem_eff", [False, True])
+    def test_layer_norm_affine_vs_torch(self, mem_eff):
+        x = _x()
+        w, b = _wb()
+        y = fused_layer_norm_affine(x, w, b, HIDDEN, 1e-5, mem_eff)
+        _, _, ty = _torch_ln(x, w, b)
+        np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_layer_norm_noaffine(self):
+        x = _x()
+        y = fused_layer_norm(x, HIDDEN)
+        ty = torch.nn.functional.layer_norm(
+            torch.tensor(np.asarray(x)), (HIDDEN,))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5,
+                                   rtol=1e-5)
+
+    @pytest.mark.parametrize("mem_eff", [False, True])
+    def test_rms_norm_affine_vs_manual(self, mem_eff):
+        x = _x(seed=3)
+        w, _ = _wb()
+        y = fused_rms_norm_affine(x, w, HIDDEN, 1e-5, mem_eff)
+        ref = manual_rms_norm(x, w, HIDDEN, 1e-5)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-5,
+                                   rtol=1e-5)
+
+    def test_bf16_io_fp32_stats(self):
+        x = _x(jnp.bfloat16, seed=5)
+        w, b = _wb()
+        y = fused_layer_norm_affine(x, w, b, HIDDEN)
+        assert y.dtype == jnp.bfloat16
+        ref = torch.nn.functional.layer_norm(
+            torch.tensor(np.asarray(x, np.float32)), (HIDDEN,),
+            torch.tensor(np.asarray(w)), torch.tensor(np.asarray(b)))
+        np.testing.assert_allclose(np.asarray(y, np.float32), ref.numpy(),
+                                   atol=3e-2, rtol=3e-2)
+
+    def test_odd_hidden_fallback(self):
+        # 100 not lane-aligned → jnp fallback path
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 100))
+        y = fused_layer_norm(x, 100)
+        ty = torch.nn.functional.layer_norm(torch.tensor(np.asarray(x)),
+                                            (100,))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5,
+                                   rtol=1e-5)
+
+
+class TestBackwardParity:
+    @pytest.mark.parametrize("mem_eff", [False, True])
+    def test_layer_norm_grads_vs_torch(self, mem_eff):
+        x = _x(seed=7)
+        w, b = _wb()
+
+        def loss(x, w, b):
+            y = fused_layer_norm_affine(x, w, b, HIDDEN, 1e-5, mem_eff)
+            return jnp.sum(y * y)
+
+        dx, dw, db = jax.grad(loss, argnums=(0, 1, 2))(x, w, b)
+
+        tx, ln, ty = _torch_ln(x, w, b)
+        (ty * ty).sum().backward()
+        np.testing.assert_allclose(np.asarray(dx), tx.grad.numpy(),
+                                   atol=1e-4, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dw), ln.weight.grad.numpy(),
+                                   atol=1e-3, rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(db), ln.bias.grad.numpy(),
+                                   atol=1e-3, rtol=1e-4)
+
+    @pytest.mark.parametrize("mem_eff", [False, True])
+    def test_rms_norm_grads_vs_jnp_reference(self, mem_eff):
+        x = _x(seed=8)
+        w, _ = _wb()
+
+        def loss_fused(x, w):
+            return jnp.sum(jnp.square(
+                fused_rms_norm_affine(x, w, HIDDEN, 1e-5, mem_eff)))
+
+        def loss_ref(x, w):
+            return jnp.sum(jnp.square(manual_rms_norm(x, w, HIDDEN, 1e-5)))
+
+        dx, dw = jax.grad(loss_fused, (0, 1))(x, w)
+        rx, rw = jax.grad(loss_ref, (0, 1))(x, w)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(rx), atol=1e-4,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(dw), np.asarray(rw), atol=1e-3,
+                                   rtol=1e-4)
+
+
+class TestModules:
+    def test_fused_layer_norm_module(self):
+        m = FusedLayerNorm(HIDDEN)
+        x = _x()
+        params = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(params, x)
+        ty = torch.nn.functional.layer_norm(torch.tensor(np.asarray(x)),
+                                            (HIDDEN,))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5,
+                                   rtol=1e-5)
+
+    def test_fused_rms_norm_module_jit_grad(self):
+        m = FusedRMSNorm(HIDDEN)
+        x = _x()
+        params = m.init(jax.random.PRNGKey(0), x)
+
+        @jax.jit
+        def step(params, x):
+            return jax.grad(
+                lambda p: jnp.sum(m.apply(p, x) ** 2))(params)
+
+        g = step(params, x)
+        assert jnp.all(jnp.isfinite(g["params"]["weight"]))

@@ -1,0 +1,119 @@
+"""L0 tests for the multi_tensor substrate (≈ the amp_C kernel family).
+
+Mirrors the reference L0 pattern: fused op vs plain reference under allclose
+(tests/L0/run_optimizers, SURVEY §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    multi_tensor_unscale_l2norm,
+    update_scale_hysteresis,
+)
+
+
+def _tree(key, dtypes=(jnp.float32, jnp.bfloat16)):
+    ks = jax.random.split(key, 4)
+    return {
+        "a": jax.random.normal(ks[0], (33, 7), dtypes[0]),
+        "b": [jax.random.normal(ks[1], (128,), dtypes[1]),
+              jax.random.normal(ks[2], (5, 5, 5), dtypes[0])],
+        "c": jax.random.normal(ks[3], (1,), dtypes[0]),
+    }
+
+
+class TestScale:
+    def test_scale(self):
+        t = _tree(jax.random.PRNGKey(0))
+        out, found = multi_tensor_scale(t, 2.5)
+        np.testing.assert_allclose(
+            np.asarray(out["a"]), np.asarray(t["a"]) * 2.5, rtol=1e-6)
+        assert not bool(found)
+
+    def test_scale_detects_inf_and_nan(self):
+        t = _tree(jax.random.PRNGKey(1))
+        t["a"] = t["a"].at[0, 0].set(jnp.inf)
+        _, found = multi_tensor_scale(t, 1.0)
+        assert bool(found)
+        t["a"] = t["a"].at[0, 0].set(jnp.nan)
+        _, found = multi_tensor_scale(t, 1.0)
+        assert bool(found)
+
+    def test_jittable(self):
+        t = _tree(jax.random.PRNGKey(2))
+        out, found = jax.jit(multi_tensor_scale)(t, jnp.float32(0.5))
+        assert out["a"].dtype == t["a"].dtype
+
+
+class TestAxpby:
+    def test_axpby(self):
+        x = _tree(jax.random.PRNGKey(3))
+        y = _tree(jax.random.PRNGKey(4))
+        out, found = multi_tensor_axpby(2.0, x, -1.0, y)
+        ref = 2.0 * np.asarray(x["a"]) - np.asarray(y["a"])
+        np.testing.assert_allclose(np.asarray(out["a"]), ref, rtol=1e-6)
+
+
+class TestL2Norm:
+    def test_global_matches_numpy(self):
+        t = _tree(jax.random.PRNGKey(5), (jnp.float32, jnp.float32))
+        g, _ = multi_tensor_l2norm(t)
+        flat = np.concatenate([np.asarray(l).ravel()
+                               for l in jax.tree_util.tree_leaves(t)])
+        np.testing.assert_allclose(float(g), np.linalg.norm(flat), rtol=1e-5)
+
+    def test_per_tensor(self):
+        t = [jnp.ones((10,)), 2 * jnp.ones((4,))]
+        g, pt = multi_tensor_l2norm(t, per_tensor=True)
+        np.testing.assert_allclose(np.asarray(pt),
+                                   [np.sqrt(10.0), 4.0], rtol=1e-6)
+
+    def test_unscale_l2norm(self):
+        t = [jnp.full((8,), 4.0)]
+        out, g, _, found = multi_tensor_unscale_l2norm(t, 0.25)
+        np.testing.assert_allclose(np.asarray(out[0]), np.ones(8), rtol=1e-6)
+        assert not bool(found)
+
+
+class TestUpdateScaleHysteresis:
+    """State-machine parity with csrc/update_scale_hysteresis.cu:5-41."""
+
+    def test_growth_after_interval(self):
+        s, g, h = jnp.float32(2.0), jnp.int32(0), jnp.int32(2)
+        for _ in range(3):
+            s, g, h = update_scale_hysteresis(s, g, h, False, 2.0, 0.5, 3, 2)
+        assert float(s) == 4.0 and int(g) == 0
+
+    def test_backoff_consumes_hysteresis_first(self):
+        s, g, h = jnp.float32(8.0), jnp.int32(1), jnp.int32(2)
+        s, g, h = update_scale_hysteresis(s, g, h, True, 2.0, 0.5, 100, 2)
+        assert float(s) == 8.0 and int(h) == 1 and int(g) == 0
+        s, g, h = update_scale_hysteresis(s, g, h, True, 2.0, 0.5, 100, 2)
+        # hysteresis exhausted → backoff; NOT replenished by the backoff
+        # (update_scale_hysteresis.cu:38-40 replenishes only on clean steps)
+        assert float(s) == 4.0 and int(h) == 0
+        s, g, h = update_scale_hysteresis(s, g, h, True, 2.0, 0.5, 100, 2)
+        assert float(s) == 2.0  # every further inf step backs off
+
+    def test_clean_step_replenishes_hysteresis(self):
+        s, g, h = jnp.float32(8.0), jnp.int32(0), jnp.int32(1)
+        s, g, h = update_scale_hysteresis(s, g, h, False, 2.0, 0.5, 100, 2)
+        assert int(h) == 2
+
+    def test_growth_never_reaches_inf(self):
+        # reference guards growth with isfinite (update_scale_hysteresis.cu:28-30)
+        s, g, h = jnp.float32(3e38), jnp.int32(0), jnp.int32(1)
+        s, g, h = update_scale_hysteresis(s, g, h, False, 2.0, 0.5, 1, 1)
+        assert float(s) == jnp.float32(3e38) and jnp.isfinite(s)
+
+    def test_jit_roundtrip(self):
+        f = jax.jit(lambda s, g, h, fi: update_scale_hysteresis(
+            s, g, h, fi, 2.0, 0.5, 2000, 1))
+        s, g, h = f(jnp.float32(65536.0), jnp.int32(0), jnp.int32(1), True)
+        assert float(s) == 32768.0
