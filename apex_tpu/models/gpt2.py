@@ -1,0 +1,116 @@
+"""GPT-2 family — the flagship benchmark model (BASELINE.md config 5:
+GPT-2 1.5B + megatron scaled_masked_softmax + fused MHA).
+
+Built entirely from the framework's fused components: FusedLayerNorm (Pallas),
+flash attention (Pallas, = fused MHA + causal megatron softmax),
+dense_gelu_dense (fused MLP), fused xentropy loss. bf16-first compute with
+fp32 params by default (amp O1 shape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm
+from apex_tpu.ops.pallas.flash_attention import flash_attention
+from apex_tpu.transformer.fused_dense import dense_gelu_dense
+from apex_tpu.transformer.mha import mha_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    compute_dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, n_positions=256, n_embd=256, n_layer=2,
+                   n_head=4)
+
+    @classmethod
+    def small(cls):
+        return cls()
+
+    @classmethod
+    def xl(cls):  # GPT-2 1.5B
+        return cls(n_embd=1600, n_layer=48, n_head=25)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.cfg
+        h = c.n_head
+        d = c.n_embd // h
+        b, s, e = x.shape
+
+        y = FusedLayerNorm(e, name="ln_1")(x)
+        qkv = nn.Dense(3 * e, dtype=c.compute_dtype, name="attn_qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if s % 128 == 0:
+            o = flash_attention(q, k, v, True)
+        else:
+            o = mha_reference(q, k, v, True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        x = x + nn.Dense(e, dtype=c.compute_dtype, name="attn_out")(o)
+
+        y = FusedLayerNorm(e, name="ln_2")(x)
+        w1 = self.param("mlp_fc_w", nn.initializers.normal(0.02),
+                        (4 * e, e), jnp.float32)
+        b1 = self.param("mlp_fc_b", nn.initializers.zeros, (4 * e,),
+                        jnp.float32)
+        w2 = self.param("mlp_proj_w", nn.initializers.normal(0.02),
+                        (e, 4 * e), jnp.float32)
+        b2 = self.param("mlp_proj_b", nn.initializers.zeros, (e,),
+                        jnp.float32)
+        x = x + dense_gelu_dense(y, w1.astype(c.compute_dtype),
+                                 b1.astype(c.compute_dtype),
+                                 w2.astype(c.compute_dtype),
+                                 b2.astype(c.compute_dtype))
+        return x
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens):
+        c = self.cfg
+        b, s = tokens.shape
+        wte = self.param("wte", nn.initializers.normal(0.02),
+                         (c.vocab_size, c.n_embd), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(0.01),
+                         (c.n_positions, c.n_embd), jnp.float32)
+        x = wte[tokens].astype(c.compute_dtype) \
+            + wpe[:s][None].astype(c.compute_dtype)
+        for i in range(c.n_layer):
+            x = Block(c, name=f"h_{i}")(x)
+        x = FusedLayerNorm(c.n_embd, name="ln_f")(x)
+        logits = jax.lax.dot_general(
+            x, wte.astype(c.compute_dtype), (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return logits
+
+
+def lm_loss(model: GPT2, params, tokens):
+    """Next-token xentropy over the fused loss (contrib.xentropy)."""
+    logits = model.apply(params, tokens)
+    loss = softmax_cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
+    return jnp.mean(loss)

@@ -1,0 +1,240 @@
+"""Manually-parallel GPT-2 — the multi-chip training step: DP × TP × SP over a
+``jax.sharding.Mesh`` via ``shard_map``.
+
+Composition (the 'How to Scale Your Model' recipe, hand-annotated):
+- **DP**: batch sharded over ``dp``; grads of every param psum over dp
+  (the bucketed-psum DDP capability, apex_tpu.parallel.ddp).
+- **TP**: Megatron column/row parallel linears over ``tp`` — q/k/v projections
+  column-sharded (heads split), attention output row-sharded with a psum;
+  MLP fc column-sharded, proj row-sharded with a psum. The wgrad-accum
+  primitive semantics (fp32 grads for low-precision params) ride on
+  preferred_element_type.
+- **SP**: sequence sharded over ``sp``; attention runs the ring
+  (apex_tpu.parallel.ring_attention) so K/V shards rotate over ICI while Q
+  stays resident; positional embeddings sharded with the sequence.
+
+Pipeline (pp) and expert (ep) axes: not yet wired (round-1 scope; the mesh
+helper accepts them as size-1 axes so the step signature is stable).
+
+All params/optimizer state live in fp32; compute in bf16 (amp O1 shape);
+optimizer is the fused Adam tree update (optimizers/functional.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.models.gpt2 import GPT2Config
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.normalization.fused_layer_norm import (fused_layer_norm_affine)
+from apex_tpu.optimizers.functional import adam_update
+from apex_tpu.parallel.ring_attention import ring_self_attention
+
+_f32 = jnp.float32
+
+
+def choose_mesh_shape(n: int) -> Tuple[int, int, int]:
+    """Factor n devices into (dp, tp, sp), preferring dp ≥ tp ≥ sp."""
+    dp = tp = sp = 1
+    for axis in ("dp", "tp", "sp", "dp", "tp", "sp"):
+        if n % 2 != 0 or n == 1:
+            break
+        n //= 2
+        if axis == "dp":
+            dp *= 2
+        elif axis == "tp":
+            tp *= 2
+        else:
+            sp *= 2
+    dp *= n  # leftover odd factor onto dp
+    return dp, tp, sp
+
+
+def init_params(cfg: GPT2Config, key) -> Dict[str, Any]:
+    """Full (unsharded) param dict; shard_map slices per the specs below."""
+    ks = jax.random.split(key, 4 + cfg.n_layer)
+    e = cfg.n_embd
+    p = {
+        "wte": jax.random.normal(ks[0], (cfg.vocab_size, e), _f32) * 0.02,
+        "wpe": jax.random.normal(ks[1], (cfg.n_positions, e), _f32) * 0.01,
+        "lnf_w": jnp.ones((e,), _f32),
+        "lnf_b": jnp.zeros((e,), _f32),
+        "blocks": [],
+    }
+    for i in range(cfg.n_layer):
+        bk = jax.random.split(ks[4 + i], 6)
+        std = 0.02
+        p["blocks"].append({
+            "ln1_w": jnp.ones((e,), _f32), "ln1_b": jnp.zeros((e,), _f32),
+            "wq": jax.random.normal(bk[0], (e, e), _f32) * std,
+            "wk": jax.random.normal(bk[1], (e, e), _f32) * std,
+            "wv": jax.random.normal(bk[2], (e, e), _f32) * std,
+            "wo": jax.random.normal(bk[3], (e, e), _f32) * std
+                  / math.sqrt(2 * cfg.n_layer),
+            "ln2_w": jnp.ones((e,), _f32), "ln2_b": jnp.zeros((e,), _f32),
+            "fc_w": jax.random.normal(bk[4], (e, 4 * e), _f32) * std,
+            "fc_b": jnp.zeros((4 * e,), _f32),
+            "proj_w": jax.random.normal(bk[5], (4 * e, e), _f32) * std
+                      / math.sqrt(2 * cfg.n_layer),
+            "proj_b": jnp.zeros((e,), _f32),
+        })
+    return p
+
+
+def param_specs(cfg: GPT2Config) -> Dict[str, Any]:
+    """PartitionSpecs: TP-sharded projections, SP-sharded positions."""
+    col = P(None, "tp")   # column parallel (output dim sharded)
+    row = P("tp", None)   # row parallel (input dim sharded)
+    rep = P()
+    block = {
+        "ln1_w": rep, "ln1_b": rep,
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "ln2_w": rep, "ln2_b": rep,
+        "fc_w": col, "fc_b": P("tp"), "proj_w": row, "proj_b": rep,
+    }
+    return {
+        "wte": rep,
+        "wpe": P("sp", None),
+        "lnf_w": rep, "lnf_b": rep,
+        "blocks": [dict(block) for _ in range(cfg.n_layer)],
+    }
+
+
+def _grad_sync_specs(cfg: GPT2Config) -> Dict[str, Any]:
+    """Axes each param's grad must be psum'd over = axes it is replicated on.
+    Encoded as '|'-joined strings so the spec tree has leaf-for-leaf structure
+    with the grad tree."""
+    tp_sharded = "dp|sp"          # grads of tp-sharded params
+    replicated = "dp|sp|tp"
+    block = {
+        "ln1_w": replicated, "ln1_b": replicated,
+        "wq": tp_sharded, "wk": tp_sharded, "wv": tp_sharded,
+        "wo": tp_sharded,
+        "ln2_w": replicated, "ln2_b": replicated,
+        "fc_w": tp_sharded, "fc_b": tp_sharded, "proj_w": tp_sharded,
+        "proj_b": replicated,
+    }
+    return {
+        "wte": replicated,
+        "wpe": "dp|tp",           # sp-sharded: sum over dp and tp only
+        "lnf_w": replicated, "lnf_b": replicated,
+        "blocks": [dict(block) for _ in range(cfg.n_layer)],
+    }
+
+
+def _forward_local(cfg: GPT2Config, params, tokens, targets, mask):
+    """Per-shard forward: tokens (b_local, s_local) on a (dp, tp, sp) mesh."""
+    cd = cfg.compute_dtype
+    e = cfg.n_embd
+    tp = jax.lax.axis_size("tp")
+    h_local = cfg.n_head // tp
+    d = e // cfg.n_head
+
+    # wpe is sp-sharded over positions; the parallel path trains at full
+    # context length (seq == n_positions) so position shards align with
+    # sequence shards
+    sp = jax.lax.axis_size("sp")
+    assert tokens.shape[1] * sp == cfg.n_positions, (
+        f"parallel GPT-2 requires seq == n_positions "
+        f"({tokens.shape[1]}*{sp} != {cfg.n_positions})")
+    x = params["wte"][tokens].astype(cd) + params["wpe"][None].astype(cd)
+    b, s_local, _ = x.shape
+
+    for blk in params["blocks"]:
+        y = fused_layer_norm_affine(x, blk["ln1_w"], blk["ln1_b"], e)
+        q = (y @ blk["wq"].astype(cd))
+        k = (y @ blk["wk"].astype(cd))
+        v = (y @ blk["wv"].astype(cd))
+
+        def heads(t):
+            return t.reshape(b, s_local, h_local, d).transpose(0, 2, 1, 3)
+
+        o = ring_self_attention(heads(q), heads(k), heads(v), "sp",
+                                causal=True)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s_local, h_local * d)
+        # row-parallel output projection: partial matmul + psum over tp
+        attn = jax.lax.psum(o @ blk["wo"].astype(cd), "tp")
+        x = x + attn
+
+        y = fused_layer_norm_affine(x, blk["ln2_w"], blk["ln2_b"], e)
+        hmid = jax.nn.gelu(y @ blk["fc_w"].astype(cd)
+                           + blk["fc_b"].astype(cd), approximate=False)
+        mlp = jax.lax.psum(hmid @ blk["proj_w"].astype(cd), "tp")
+        x = x + (mlp + blk["proj_b"].astype(cd))
+
+    x = fused_layer_norm_affine(x, params["lnf_w"], params["lnf_b"], e)
+    logits = jax.lax.dot_general(x, params["wte"].astype(cd),
+                                 (((2,), (1,)), ((), ())),
+                                 preferred_element_type=_f32)
+    loss_tok = softmax_cross_entropy_loss(logits, targets)
+    # global masked mean over the dp × sp data shards
+    tot = jax.lax.psum(jax.lax.psum(jnp.sum(loss_tok * mask), "dp"), "sp")
+    cnt = jax.lax.psum(jax.lax.psum(jnp.sum(mask), "dp"), "sp")
+    return tot / jnp.maximum(cnt, 1.0)
+
+
+def make_train_step(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4):
+    """Returns jitted train_step(params, opt_state, tokens, targets, mask, step)
+    → (params, opt_state, loss). Inputs are FULL arrays; sharding via specs."""
+    pspecs = param_specs(cfg)
+    sync_axes = _grad_sync_specs(cfg)
+
+    def local_step(params, m, v, tokens, targets, mask, step):
+        def loss_fn(p):
+            return _forward_local(cfg, p, tokens, targets, mask)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+
+        # gradient sync: psum over every axis the param is replicated on.
+        # With check_vma=False shard_map does not track replication, so the
+        # replicated loss seeds a cotangent on EVERY device and each psum
+        # transpose re-broadcasts it — after the sync psums the result is
+        # exactly (dp·tp·sp)× the true gradient, for every param class
+        # (verified empirically across (2,1,1)...(8,1,1),(1,8,1),(4,2,1),
+        # (1,2,4) meshes). Normalize by the total mesh size.
+        n_total = (jax.lax.axis_size("dp") * jax.lax.axis_size("tp")
+                   * jax.lax.axis_size("sp"))
+
+        def sync(g, axes):
+            for ax in axes.split("|"):
+                g = jax.lax.psum(g, ax)
+            return g / n_total
+
+        grads = jax.tree_util.tree_map(sync, grads, sync_axes)
+
+        params, m, v = adam_update(params, grads, m, v, step=step, lr=lr,
+                                   weight_decay=0.01)
+        return params, m, v, loss
+
+    state_specs = pspecs  # optimizer state sharded exactly like its params
+
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(pspecs, state_specs, state_specs,
+                  P("dp", "sp"), P("dp", "sp"), P("dp", "sp"), P()),
+        out_specs=(pspecs, state_specs, state_specs, P()),
+        check_vma=False)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets, mask, step):
+        m, v = opt_state
+        params, m, v, loss = sharded(params, m, v, tokens, targets, mask,
+                                     step)
+        return params, (m, v), loss
+
+    return train_step
+
+
+def init_opt_state(params):
+    z = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, _f32), params)
+    z2 = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, _f32), params)
+    return (z, z2)

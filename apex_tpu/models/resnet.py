@@ -1,0 +1,98 @@
+"""ResNet family (flax, NHWC) — the imagenet benchmark model of the reference
+(examples/imagenet/main_amp.py recipe; BASELINE.md configs 2-3: ResNet-50 +
+FusedAdam single chip, + DDP/SyncBN on a v5e-8 mesh).
+
+TPU-first choices: NHWC layout (XLA's native conv layout on TPU), bf16 compute
+with fp32 norm statistics, SyncBatchNorm from apex_tpu.parallel as the norm
+layer (axis_name=None degrades to plain BN for single-chip runs). The
+bottleneck block mirrors torchvision semantics (the reference's
+contrib.bottleneck accelerates the same block with cuDNN fusions — on TPU the
+conv+BN+ReLU chains fuse in XLA).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm
+
+
+class Bottleneck(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck with residual (expansion 4)."""
+
+    features: int
+    strides: int = 1
+    axis_name: Optional[str] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, use_running_average=False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       param_dtype=jnp.float32)
+        bn = partial(SyncBatchNorm, axis_name=self.axis_name,
+                     channel_axis=-1)
+        needs_proj = (x.shape[-1] != self.features * 4 or self.strides != 1)
+        residual = x
+        y = conv(self.features, (1, 1), name="conv1")(x)
+        y = bn(self.features, name="bn1", fuse_relu=True)(
+            y, use_running_average)
+        y = conv(self.features, (3, 3), strides=(self.strides,) * 2,
+                 name="conv2")(y)
+        y = bn(self.features, name="bn2", fuse_relu=True)(
+            y, use_running_average)
+        y = conv(self.features * 4, (1, 1), name="conv3")(y)
+        y = bn(self.features * 4, name="bn3")(y, use_running_average)
+        if needs_proj:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.strides,) * 2,
+                            name="downsample_conv")(x)
+            residual = bn(self.features * 4, name="downsample_bn")(
+                residual, use_running_average)
+        return jnp.maximum(y + residual.astype(y.dtype), 0.0)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    axis_name: Optional[str] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.compute_dtype,
+                    param_dtype=jnp.float32, name="conv1")(x)
+        x = SyncBatchNorm(64, axis_name=self.axis_name, fuse_relu=True,
+                          name="bn1")(x, use_running_average)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        features = 64
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for blk in range(n_blocks):
+                strides = 2 if (stage > 0 and blk == 0) else 1
+                x = Bottleneck(features, strides, self.axis_name,
+                               self.compute_dtype,
+                               name=f"stage{stage}_block{blk}")(
+                    x, use_running_average)
+            features *= 2
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="fc")(x)
+        return x
+
+
+def ResNet50(num_classes: int = 1000, axis_name: Optional[str] = None,
+             compute_dtype: Any = jnp.bfloat16) -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes, axis_name, compute_dtype)
+
+
+def ResNet18ish(num_classes: int = 10, axis_name: Optional[str] = None,
+                compute_dtype: Any = jnp.bfloat16) -> ResNet:
+    """Small stand-in for fast tests (bottleneck blocks, [1,1,1,1] stages)."""
+    return ResNet([1, 1, 1, 1], num_classes, axis_name, compute_dtype)

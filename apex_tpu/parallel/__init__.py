@@ -11,3 +11,16 @@ from apex_tpu.parallel.sync_batch_norm import (  # noqa: F401
     SyncBatchNorm,
     sync_batch_norm_stats,
 )
+from apex_tpu.parallel.halo import (  # noqa: F401
+    HaloExchanger,
+    HaloExchangerAllGather,
+    HaloExchangerNoComm,
+    HaloExchangerPeer,
+    HaloExchangerSendRecv,
+    halo_exchange_1d,
+    left_right_halo_exchange,
+)
+from apex_tpu.parallel.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_self_attention,
+)

@@ -1,0 +1,32 @@
+"""Transformer kernel pack — TPU equivalents of csrc/megatron + fused_dense +
+mlp_cuda (SURVEY §7 step 7)."""
+
+from apex_tpu.transformer.softmax import (  # noqa: F401
+    generic_scaled_masked_softmax,
+    get_batch_per_block,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.rope import (  # noqa: F401
+    fused_rope,
+    fused_rope_2d,
+    fused_rope_cached,
+    fused_rope_thd,
+)
+from apex_tpu.transformer.fused_dense import (  # noqa: F401
+    FusedDense,
+    FusedDenseGeluDense,
+    dense_gelu_dense,
+    linear_bias,
+)
+from apex_tpu.transformer.mlp import MLP, mlp_forward  # noqa: F401
+from apex_tpu.transformer.wgrad import (  # noqa: F401
+    wgrad_gemm_accum_fp16,
+    wgrad_gemm_accum_fp32,
+)
+from apex_tpu.transformer.mha import (  # noqa: F401
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+    mha_reference,
+)

@@ -1,0 +1,114 @@
+"""Fused multi-head attention modules — the capability of the removed
+``apex.contrib.fast_multihead_attn`` (BASELINE.json config 5; absent from the
+snapshot per SURVEY §2 — built here against the Pallas flash kernel + megatron
+softmax semantics + RoPE, as BASELINE.md directs).
+
+``mha_reference`` is the pure-jnp spec implementation (the reference-module
+pattern of apex's tests, e.g. _transducer_ref.py) used by the parity tests.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.pallas.flash_attention import flash_attention
+from apex_tpu.transformer.rope import fused_rope_cached
+from apex_tpu.transformer.softmax import (scaled_masked_softmax,
+                                          scaled_upper_triang_masked_softmax)
+
+_f32 = jnp.float32
+
+
+def mha_reference(q, k, v, causal=False, mask=None, scale=None):
+    """Unfused attention via the megatron softmax ops (parity oracle)."""
+    s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(_f32), k.astype(_f32))
+    if causal:
+        probs = scaled_upper_triang_masked_softmax(logits, s)
+    else:
+        probs = scaled_masked_softmax(logits, mask, s)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(_f32),
+                      v.astype(_f32)).astype(q.dtype)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Self-attention block ≈ fast_multihead_attn's SelfMultiheadAttn.
+
+    Input (b, s, e); fused QKV projection, Pallas flash attention core
+    (causal or full), output projection. ``use_rope`` threads the fused
+    rotary embedding (csrc/megatron RoPE equivalent) into q/k.
+    """
+
+    embed_dim: int
+    num_heads: int
+    causal: bool = False
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jax.Array] = None):
+        b, s, e = x.shape
+        h = self.num_heads
+        d = e // h
+        qkv = nn.Dense(3 * e, use_bias=True, param_dtype=self.param_dtype,
+                       dtype=x.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.use_rope:
+            pos = jnp.arange(s, dtype=_f32)
+            inv = self.rope_theta ** (-jnp.arange(0, d, 2, dtype=_f32) / d)
+            f = pos[:, None] * inv[None, :]
+            f = jnp.concatenate([f, f], axis=-1)          # (s, d)
+            cos, sin = jnp.cos(f), jnp.sin(f)
+            # rope expects (s, ...) leading; move seq axis first
+            q = fused_rope_cached(q.transpose(2, 0, 1, 3), cos[:, None, None, :],
+                                  sin[:, None, None, :]).transpose(1, 2, 0, 3)
+            k = fused_rope_cached(k.transpose(2, 0, 1, 3), cos[:, None, None, :],
+                                  sin[:, None, None, :]).transpose(1, 2, 0, 3)
+        if mask is None and s % 128 == 0:
+            o = flash_attention(q, k, v, self.causal)
+        else:
+            o = mha_reference(q, k, v, self.causal, mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        return nn.Dense(e, use_bias=True, param_dtype=self.param_dtype,
+                        dtype=x.dtype, name="out")(o)
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Cross-attention ≈ fast_multihead_attn's EncdecMultiheadAttn."""
+
+    embed_dim: int
+    num_heads: int
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key_value, mask: Optional[jax.Array] = None):
+        b, sq, e = query.shape
+        sk = key_value.shape[1]
+        h = self.num_heads
+        d = e // h
+        q = nn.Dense(e, param_dtype=self.param_dtype, dtype=query.dtype,
+                     name="q")(query)
+        kv = nn.Dense(2 * e, param_dtype=self.param_dtype,
+                      dtype=key_value.dtype, name="kv")(key_value)
+        k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
+        k = k.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
+        v = v.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
+        if mask is None and sq % 128 == 0 and sk % 128 == 0:
+            o = flash_attention(q, k, v, False)
+        else:
+            o = mha_reference(q, k, v, False, mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, sq, e)
+        return nn.Dense(e, param_dtype=self.param_dtype, dtype=query.dtype,
+                        name="out")(o)

@@ -1,0 +1,74 @@
+"""Whole-MLP fused forward/backward — TPU equivalent of ``mlp_cuda``
+(csrc/mlp.cpp:20-33 variadic layer list, csrc/mlp_cuda.cu fused
+bias+activation kernels) and the frontend ``apex/mlp/mlp.py:33``.
+
+The reference runs the full MLP in one call: per-layer cuBLAS GEMM + fused
+bias/activation, with handwritten semaphore-based bias-grad reductions in
+backward (mlp_cuda.cu:553). On TPU the entire stack below lives in ONE jitted
+XLA program — every bias/activation fuses into its GEMM's epilogue and the
+bias-grad reductions are XLA column reductions; the multi-CTA semaphore
+machinery has no analog because XLA's dataflow graph serializes exactly where
+needed (SURVEY §5 race detection note).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+_ACTS = {
+    "none": lambda h: h,
+    "relu": lambda h: jnp.maximum(h, 0.0),
+    "sigmoid": jax.nn.sigmoid,
+}
+
+
+def mlp_forward(x: jax.Array, weights: Sequence[jax.Array],
+                biases: Sequence[jax.Array] | None,
+                activation: str = "relu") -> jax.Array:
+    """Run the whole MLP (activation after every layer except the last,
+    matching the reference's semantics in mlp.cpp / tests/L0/run_mlp)."""
+    act = _ACTS[activation]
+    h = x
+    n = len(weights)
+    for i, w in enumerate(weights):
+        h = jax.lax.dot_general(h, w, (((h.ndim - 1,), (1,)), ((), ())),
+                                preferred_element_type=_f32)
+        if biases is not None:
+            h = h + biases[i].astype(_f32)
+        if i < n - 1:
+            h = act(h)
+        h = h.astype(x.dtype)
+    return h
+
+
+class MLP(nn.Module):
+    """flax module ≈ ``apex.mlp.MLP(mlp_sizes, bias, activation)``.
+
+    ``mlp_sizes`` = [in, hidden..., out]; weights stored (out, in) like torch.
+    """
+
+    mlp_sizes: Sequence[int]
+    use_bias: bool = True
+    activation: str = "relu"
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        ws, bs = [], []
+        for i in range(len(self.mlp_sizes) - 1):
+            ws.append(self.param(
+                f"weight_{i}", nn.initializers.lecun_normal(),
+                (self.mlp_sizes[i + 1], self.mlp_sizes[i]),
+                self.param_dtype))
+            if self.use_bias:
+                bs.append(self.param(
+                    f"bias_{i}", nn.initializers.zeros,
+                    (self.mlp_sizes[i + 1],), self.param_dtype))
+        return mlp_forward(x, ws, bs if self.use_bias else None,
+                           self.activation)

@@ -1,0 +1,137 @@
+"""Fused rotary positional embedding — TPU equivalent of
+``fused_rotary_positional_embedding`` (csrc/megatron/fused_rotary_positional_embedding.{h,cu,cpp}).
+
+Variants mirrored (fused_rotary_positional_embedding.cpp:176-193):
+- ``fused_rope(t, freqs)``            sbhd layout (s, b, h, d)
+- ``fused_rope_cached(t, cos, sin)``  precomputed cos/sin tables
+- ``fused_rope_thd(t, cu_seqlens, freqs)``  packed variable-length batches
+- ``fused_rope_2d(t, freqs_h, freqs_w)``    image (2D) rotary
+
+Rotation rule (fused_rope_block_forward, .h:28-61): only the first ``d2 =
+freqs.shape[-1]`` channels rotate; NeoX rotate-half pairing
+``out[d] = in[d]·cos(f[d]) + rot_half(in)[d]·sin(f[d])`` with
+``rot_half(x)[d] = -x[d+d2/2]`` for d < d2/2 else ``x[d-d2/2]``; trailing
+``d-d2`` channels pass through. Backward = rotation by -f (the reference's
+separate backward kernel, .h:63-97) — expressed here via custom_vjp so autodiff
+never materializes intermediate products.
+
+All math fp32; IO dtype preserved. XLA fuses the elementwise chain; there is
+no launch overhead to amortize, so no Pallas kernel is needed for this op.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def _rot_half(x):
+    d2 = x.shape[-1]
+    a, b = x[..., : d2 // 2], x[..., d2 // 2:]
+    return jnp.concatenate([-b, a], axis=-1)
+
+
+def _apply_rope(x, cos, sin):
+    """x: (..., d); cos/sin broadcastable (..., d2) with d2 <= d."""
+    d = x.shape[-1]
+    d2 = cos.shape[-1]
+    x32 = x.astype(_f32)
+    head, tail = x32[..., :d2], x32[..., d2:]
+    out = head * cos + _rot_half(head) * sin
+    if d2 < d:
+        out = jnp.concatenate([out, tail], axis=-1)
+    return out.astype(x.dtype)
+
+
+@jax.custom_vjp
+def _rope_cached(x, cos, sin):
+    return _apply_rope(x, cos, sin)
+
+
+def _rope_cached_fwd(x, cos, sin):
+    return _apply_rope(x, cos, sin), (cos, sin)
+
+
+def _rope_cached_bwd(res, dy):
+    cos, sin = res
+    # inverse rotation: R(-f) == transpose of R(f)
+    dx = _apply_rope(dy, cos, -sin)
+    return dx, None, None
+
+
+_rope_cached.defvjp(_rope_cached_fwd, _rope_cached_bwd)
+
+
+def fused_rope(t: jax.Array, freqs: jax.Array,
+               transpose_output_memory: bool = False) -> jax.Array:
+    """sbhd variant: t (s, b, h, d), freqs (s, 1, 1, d2) or (s, d2).
+
+    ``transpose_output_memory`` is a CUDA memory-layout knob; XLA owns layout
+    on TPU — accepted for parity, ignored.
+    """
+    if freqs.ndim == 2:
+        freqs = freqs[:, None, None, :]
+    cos = jnp.cos(freqs.astype(_f32))
+    sin = jnp.sin(freqs.astype(_f32))
+    return _rope_cached(t, cos, sin)
+
+
+def fused_rope_cached(t: jax.Array, cos: jax.Array,
+                      sin: jax.Array) -> jax.Array:
+    """Cached-freqs variant (``fused_rope_forward_cached``)."""
+    while cos.ndim < t.ndim:
+        cos = jnp.expand_dims(cos, 1)
+        sin = jnp.expand_dims(sin, 1)
+    return _rope_cached(t, cos.astype(_f32), sin.astype(_f32))
+
+
+def fused_rope_thd(t: jax.Array, cu_seqlens: jax.Array,
+                   freqs: jax.Array) -> jax.Array:
+    """Packed thd variant (``fused_rope_forward_thd``): t (total_t, h, d);
+    ``cu_seqlens`` (b+1,) cumulative sequence starts; each token rotates by its
+    position WITHIN its own sequence.
+
+    TPU note: implemented with a vectorized searchsorted over the static token
+    axis (no dynamic shapes), so it stays jittable.
+    """
+    total = t.shape[0]
+    tok = jnp.arange(total, dtype=jnp.int32)
+    # sequence id of each token, then its in-sequence position
+    seq_id = jnp.searchsorted(cu_seqlens.astype(jnp.int32), tok,
+                              side="right") - 1
+    seq_id = jnp.clip(seq_id, 0, cu_seqlens.shape[0] - 2)
+    pos = tok - cu_seqlens.astype(jnp.int32)[seq_id]
+    if freqs.ndim > 2:
+        freqs = freqs.reshape(freqs.shape[0], freqs.shape[-1])
+    f = freqs.astype(_f32)[pos]            # (total_t, d2)
+    cos = jnp.cos(f)[:, None, :]           # broadcast over heads
+    sin = jnp.sin(f)[:, None, :]
+    return _rope_cached(t, cos, sin)
+
+
+def fused_rope_2d(t: jax.Array, img_h: int, img_w: int,
+                  freqs_h: jax.Array, freqs_w: jax.Array) -> jax.Array:
+    """2D (image) variant (``fused_rope_forward_2d``): t (b, img_h*img_w, h, d);
+    first half of channels rotates by the row frequency, second half by the
+    column frequency."""
+    b, s, h, d = t.shape
+    assert s == img_h * img_w, "sequence must equal img_h*img_w"
+    if freqs_h.ndim > 2:
+        freqs_h = freqs_h.reshape(freqs_h.shape[-2], freqs_h.shape[-1])
+        freqs_w = freqs_w.reshape(freqs_w.shape[-2], freqs_w.shape[-1])
+    d2h = freqs_h.shape[-1]
+    d2w = freqs_w.shape[-1]
+    fh = jnp.repeat(freqs_h.astype(_f32)[:img_h], img_w, axis=0)  # (s, d2h)
+    fw = jnp.tile(freqs_w.astype(_f32)[:img_w], (img_h, 1))       # (s, d2w)
+    t_h, t_w = t[..., :d2h], t[..., d2h:d2h + d2w]
+    rest = t[..., d2h + d2w:]
+    out_h = _rope_cached(t_h, jnp.cos(fh)[None, :, None, :],
+                         jnp.sin(fh)[None, :, None, :])
+    out_w = _rope_cached(t_w, jnp.cos(fw)[None, :, None, :],
+                         jnp.sin(fw)[None, :, None, :])
+    return jnp.concatenate([out_h, out_w, rest], axis=-1)

@@ -1,0 +1,127 @@
+"""Fused attention-score softmax family — TPU equivalent of the four megatron
+CUDA modules (``csrc/megatron/scaled_*_softmax*``, setup.py:292-374):
+
+- ``scaled_softmax``                      (unmasked, scale only)
+- ``scaled_masked_softmax``               (arbitrary uint8 mask)
+- ``scaled_upper_triang_masked_softmax``  (causal)
+- ``generic_scaled_masked_softmax``       (unlimited sequence length)
+
+Reference semantics preserved (scaled_masked_softmax.h:211-333):
+- inputs scaled then masked positions filled with -10000.0 (not -inf);
+- fully-masked rows output ZEROS, not NaN (``scale_value = 0`` when the row max
+  is the fill value, :297);
+- math in fp32 regardless of IO dtype; backward is the fused
+  ``dy→(dy - Σ dy·y)·y·scale`` chain (:106-207 backward kernels).
+
+On TPU one implementation covers all row lengths (no 16k warp limit — the
+"generic" variant is the same code), and XLA fuses the whole chain into a
+row-tiled loop; a custom VJP keeps the backward as one fused pass saving only
+the softmax output, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+MASK_FILL = -10000.0
+
+
+def _softmax_rows(x32: jax.Array) -> jax.Array:
+    m = jnp.max(x32, axis=-1, keepdims=True)
+    # fully-masked row → every element == MASK_FILL → output zeros (ref :297)
+    e = jnp.exp(x32 - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    y = e / s
+    return jnp.where(m <= MASK_FILL, jnp.zeros_like(y), y)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _scaled_softmax(x, scale):
+    return _softmax_rows(x.astype(_f32) * scale).astype(x.dtype)
+
+
+def _smsm_fwd(x, scale):
+    y = _scaled_softmax(x, scale)
+    return y, y
+
+
+def _smsm_bwd(scale, y, dy):
+    y32 = y.astype(_f32)
+    dy32 = dy.astype(_f32)
+    dx = (dy32 - jnp.sum(dy32 * y32, axis=-1, keepdims=True)) * y32 * scale
+    return (dx.astype(y.dtype),)
+
+
+_scaled_softmax.defvjp(_smsm_fwd, _smsm_bwd)
+
+
+def scaled_softmax(x: jax.Array, scale: float = 1.0) -> jax.Array:
+    """≈ ``scaled_softmax_cuda`` (no mask). x: (..., sq, sk)."""
+    return _scaled_softmax(x, scale)
+
+
+def scaled_masked_softmax(x: jax.Array, mask: Optional[jax.Array],
+                          scale: float = 1.0) -> jax.Array:
+    """≈ ``scaled_masked_softmax_cuda``. ``mask`` is 1/True = masked
+    (uint8 semantics of the reference), broadcastable to x; masked positions
+    are filled with -10000 AFTER scaling (replace, not add)."""
+    if mask is None:
+        return scaled_softmax(x, scale)
+    keep = 1.0 - mask.astype(_f32)
+    return _scaled_masked_softmax_replace(x, keep, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scaled_masked_softmax_replace(x, keep, scale):
+    x32 = x.astype(_f32) * scale
+    x32 = x32 * keep + (1.0 - keep) * MASK_FILL
+    return _softmax_rows(x32).astype(x.dtype)
+
+
+def _smsr_fwd(x, keep, scale):
+    y = _scaled_masked_softmax_replace(x, keep, scale)
+    return y, (y, keep)
+
+
+def _smsr_bwd(scale, res, dy):
+    y, keep = res
+    y32 = y.astype(_f32)
+    dy32 = dy.astype(_f32)
+    dx = (dy32 - jnp.sum(dy32 * y32, axis=-1, keepdims=True)) * y32 * scale
+    return (dx * keep).astype(y.dtype), None
+
+
+_scaled_masked_softmax_replace.defvjp(_smsr_fwd, _smsr_bwd)
+
+
+def scaled_upper_triang_masked_softmax(x: jax.Array,
+                                       scale: float = 1.0) -> jax.Array:
+    """≈ ``scaled_upper_triang_masked_softmax_cuda`` (causal attention scores).
+
+    x: (..., sq, sk) with sq == sk; position (i, j) masked when j > i
+    (scaled_upper_triang_masked_softmax.h:130).
+    """
+    sq, sk = x.shape[-2], x.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    keep = (cols <= rows).astype(_f32)
+    return _scaled_masked_softmax_replace(x, keep, scale)
+
+
+def generic_scaled_masked_softmax(x: jax.Array, mask: Optional[jax.Array],
+                                  scale: float = 1.0) -> jax.Array:
+    """≈ ``generic_scaled_masked_softmax_cuda`` — the unlimited-seq-len
+    variant (generic_scaled_masked_softmax.h). On TPU the row-tiled XLA
+    lowering has no 16k row limit, so this is the same implementation."""
+    return scaled_masked_softmax(x, mask, scale)
+
+
+def get_batch_per_block(sq: int, sk: int, b: int, np_: int) -> int:
+    """API-parity helper (scaled_masked_softmax.cpp:74). On TPU the compiler
+    owns tiling; return a nominal 1."""
+    return 1

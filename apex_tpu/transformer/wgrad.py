@@ -1,0 +1,45 @@
+"""Weight-gradient GEMM with fp32 accumulation — TPU equivalent of
+``fused_weight_gradient_mlp_cuda`` (csrc/megatron/fused_weight_gradient_dense.cpp:11-13:
+wgrad GEMM accumulating directly into the main grad buffer in fp32/fp16).
+
+This is the tensor-parallel wgrad primitive: low-precision activations/grads,
+high-precision gradient accumulator that survives many micro-batches.
+On TPU: one ``dot_general`` with ``preferred_element_type=f32`` (MXU
+accumulates in fp32 natively) added into the donated main_grad buffer — XLA
+fuses the add into the matmul epilogue, giving the same
+"accumulate into main_grad without a round-trip" behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def wgrad_gemm_accum_fp32(input_: jax.Array, grad_output: jax.Array,
+                          main_grad: jax.Array) -> jax.Array:
+    """main_grad += grad_output^T @ input, accumulated in fp32.
+
+    input_: (..., in), grad_output: (..., out), main_grad: (out, in) fp32.
+    Returns the updated main_grad (donate it under jit for in-place).
+    """
+    bdims = tuple(range(input_.ndim - 1))
+    acc = jax.lax.dot_general(
+        grad_output, input_, ((bdims, bdims), ((), ())),
+        preferred_element_type=_f32)
+    return main_grad + acc
+
+
+def wgrad_gemm_accum_fp16(input_: jax.Array, grad_output: jax.Array,
+                          main_grad: jax.Array) -> jax.Array:
+    """Low-precision accumulator variant (``wgrad_gemm_accum_fp16``). The
+    MXU still computes in fp32; only the accumulator storage is low precision."""
+    bdims = tuple(range(input_.ndim - 1))
+    acc = jax.lax.dot_general(
+        grad_output, input_, ((bdims, bdims), ((), ())),
+        preferred_element_type=_f32)
+    return (main_grad.astype(_f32) + acc).astype(main_grad.dtype)

@@ -1,0 +1,72 @@
+"""Benchmark driver — prints ONE JSON line.
+
+Headline metric (BASELINE.json row 1): fused Adam step latency at 1B params on
+one TPU chip, via the flat-buffer Pallas kernel
+(apex_tpu/ops/pallas/fused_adam_kernel.py) — the TPU equivalent of the
+reference's ``multi_tensor_adam`` launch path (csrc/multi_tensor_adam.cu:24 via
+csrc/multi_tensor_apply.cuh:32-103).
+
+Dtype mix matches the reference's common mixed-precision setup: bf16 params +
+bf16 grads + fp32 exp_avg/exp_avg_sq (fused_adam.py:212-232 groups). The op is
+HBM-bandwidth bound: bytes = N·(2+2+4+4) read + N·(2+4+4) written = 22N.
+
+``vs_baseline``: measured A100-class reference estimate for the same op =
+22N bytes / (1555 GB/s · 0.85 achievable) — apex's multi_tensor kernels reach
+~85% of HBM peak on large flat lists. vs_baseline = ref_ms / our_ms
+(>1 ⇒ faster than the A100 reference path).
+
+On non-TPU hosts (CI smoke) a small N keeps runtime sane; the driver runs this
+on the real chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    n = 1_000_000_000 if on_tpu else 4_194_304
+    # round to the flat-buffer tile granularity (8*128)
+    n = (n // 1024) * 1024
+
+    from apex_tpu.ops.pallas.fused_adam_kernel import fused_adam_flat
+
+    key = jax.random.PRNGKey(0)
+    p = jax.random.normal(key, (n,), jnp.bfloat16) * 0.02
+    g = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.bfloat16)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+
+    def step(p, g, m, v, s):
+        return fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
+                               step=s, inv_scale=1.0)
+
+    # warmup / compile
+    p, m, v = step(p, g, m, v, jnp.int32(1))
+    p.block_until_ready()
+
+    iters = 20 if on_tpu else 5
+    t0 = time.perf_counter()
+    for i in range(iters):
+        p, m, v = step(p, g, m, v, jnp.int32(2 + i))
+    p.block_until_ready()
+    ms = (time.perf_counter() - t0) / iters * 1e3
+
+    bytes_moved = n * (2 + 2 + 4 + 4 + 2 + 4 + 4)
+    ref_ms = bytes_moved / (1555e9 * 0.85) * 1e3  # A100 apex estimate
+    print(json.dumps({
+        "metric": f"fused_adam_step_ms_at_{n//1_000_000}M_params_"
+                  f"bf16p_f32state",
+        "value": round(ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(ref_ms / ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

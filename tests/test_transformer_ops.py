@@ -1,0 +1,284 @@
+"""Transformer kernel pack parity tests (megatron softmax family, RoPE,
+xentropy, fused dense/MLP, wgrad accumulation) — apex contrib test pattern:
+fused op vs jnp/torch reference under allclose."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from apex_tpu.contrib.xentropy import (SoftmaxCrossEntropyLoss,
+                                       softmax_cross_entropy_loss)
+from apex_tpu.transformer import (MLP, FusedDense, FusedDenseGeluDense,
+                                  dense_gelu_dense, fused_rope,
+                                  fused_rope_cached, fused_rope_thd,
+                                  generic_scaled_masked_softmax, linear_bias,
+                                  mlp_forward, scaled_masked_softmax,
+                                  scaled_softmax,
+                                  scaled_upper_triang_masked_softmax,
+                                  wgrad_gemm_accum_fp32)
+
+
+class TestScaledSoftmax:
+    def test_scaled_softmax_vs_jax(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 8, 16))
+        y = scaled_softmax(x, 0.5)
+        ref = jax.nn.softmax(x * 0.5, axis=-1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+    def test_masked_matches_reference_fill(self):
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 2, 4, 8))
+        mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.3,
+                                    (2, 1, 4, 8)).astype(jnp.uint8)
+        y = scaled_masked_softmax(x, mask, 2.0)
+        filled = np.where(np.asarray(mask, bool), -10000.0,
+                          np.asarray(x) * 2.0)
+        ref = jax.nn.softmax(jnp.asarray(filled), axis=-1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), atol=1e-6)
+
+    def test_fully_masked_row_is_zero(self):
+        """Reference zeros fully-masked rows (scaled_masked_softmax.h:297)."""
+        x = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 2, 8))
+        mask = jnp.ones((1, 1, 2, 8), jnp.uint8)
+        y = scaled_masked_softmax(x, mask, 1.0)
+        np.testing.assert_array_equal(np.asarray(y), 0.0)
+
+    def test_causal(self):
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 2, 6, 6))
+        y = scaled_upper_triang_masked_softmax(x, 1.0)
+        yn = np.asarray(y)
+        # strictly-upper-triangular entries must be exactly zero
+        for i in range(6):
+            for j in range(i + 1, 6):
+                np.testing.assert_array_equal(yn[..., i, j], 0.0)
+        np.testing.assert_allclose(yn.sum(-1), 1.0, atol=1e-6)
+
+    def test_backward_matches_autodiff(self):
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 2, 4, 8))
+
+        def fused(x):
+            return jnp.sum(scaled_softmax(x, 1.7) ** 2)
+
+        def ref(x):
+            return jnp.sum(jax.nn.softmax(x * 1.7, axis=-1) ** 2)
+
+        np.testing.assert_allclose(np.asarray(jax.grad(fused)(x)),
+                                   np.asarray(jax.grad(ref)(x)), atol=1e-5)
+
+    def test_generic_same_as_masked(self):
+        x = jax.random.normal(jax.random.PRNGKey(6), (1, 1, 4, 300))
+        y1 = generic_scaled_masked_softmax(x, None, 1.0)
+        y2 = scaled_softmax(x, 1.0)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+class TestRoPE:
+    def _ref_rope(self, x, freqs):
+        # NeoX rotate-half reference
+        d2 = freqs.shape[-1]
+        cos = np.cos(freqs)[:, None, None, :]
+        sin = np.sin(freqs)[:, None, None, :]
+        xh = np.asarray(x[..., :d2], np.float32)
+        rot = np.concatenate([-xh[..., d2 // 2:], xh[..., : d2 // 2]], -1)
+        out = xh * cos + rot * sin
+        return np.concatenate([out, np.asarray(x[..., d2:], np.float32)], -1)
+
+    def test_sbhd_full_rotary(self):
+        s, b, h, d = 6, 2, 3, 8
+        x = jax.random.normal(jax.random.PRNGKey(0), (s, b, h, d))
+        freqs = jax.random.normal(jax.random.PRNGKey(1), (s, d)) * 0.1
+        y = fused_rope(x, freqs)
+        np.testing.assert_allclose(np.asarray(y),
+                                   self._ref_rope(x, np.asarray(freqs)),
+                                   atol=1e-5)
+
+    def test_partial_rotary_passthrough(self):
+        s, b, h, d = 4, 1, 2, 8
+        d2 = 4
+        x = jax.random.normal(jax.random.PRNGKey(2), (s, b, h, d))
+        freqs = jnp.ones((s, d2)) * 0.3
+        y = fused_rope(x, freqs)
+        np.testing.assert_array_equal(np.asarray(y[..., d2:]),
+                                      np.asarray(x[..., d2:]))
+
+    def test_backward_is_inverse_rotation(self):
+        s, b, h, d = 4, 2, 2, 8
+        x = jax.random.normal(jax.random.PRNGKey(3), (s, b, h, d))
+        # real RoPE freqs: the two rotate-half halves share angles, making the
+        # map orthogonal (so ||grad of sum(y^2)|| == 2||y||)
+        half = jax.random.normal(jax.random.PRNGKey(4), (s, d // 2)) * 0.2
+        freqs = jnp.concatenate([half, half], axis=-1)
+
+        def loss(x):
+            return jnp.sum(fused_rope(x, freqs) ** 2)
+
+        g = jax.grad(loss)(x)
+        # rotation is orthogonal: ||grad|| == ||2*rope(x)||
+        np.testing.assert_allclose(float(jnp.linalg.norm(g)),
+                                   float(2 * jnp.linalg.norm(
+                                       fused_rope(x, freqs))), rtol=1e-5)
+
+    def test_thd_packed_matches_per_sequence(self):
+        d = 8
+        lens = [3, 5, 2]
+        cu = jnp.array([0, 3, 8, 10], jnp.int32)
+        total = 10
+        x = jax.random.normal(jax.random.PRNGKey(5), (total, 2, d))
+        freqs = jax.random.normal(jax.random.PRNGKey(6), (8, d)) * 0.1
+        y = fused_rope_thd(x, cu, freqs)
+        # each sequence rotated from position 0
+        off = 0
+        for ln in lens:
+            seq = x[off:off + ln][:, None, :, :]  # (s,1,h,d) sbhd
+            ref = fused_rope(seq, freqs[:ln])
+            np.testing.assert_allclose(np.asarray(y[off:off + ln]),
+                                       np.asarray(ref[:, 0]), atol=1e-5)
+            off += ln
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_vs_torch(self, smoothing):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (16, 50))
+        labels = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, 50)
+        loss = softmax_cross_entropy_loss(logits, labels, smoothing)
+        tl = torch.tensor(np.asarray(logits), requires_grad=True)
+        tt = torch.tensor(np.asarray(labels), dtype=torch.long)
+        tloss = torch.nn.functional.cross_entropy(
+            tl, tt, label_smoothing=smoothing, reduction="none")
+        np.testing.assert_allclose(np.asarray(loss), tloss.detach().numpy(),
+                                   atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_grad_vs_torch(self, smoothing):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (8, 20))
+        labels = jax.random.randint(jax.random.PRNGKey(3), (8,), 0, 20)
+        g = jax.grad(lambda x: jnp.sum(
+            softmax_cross_entropy_loss(x, labels, smoothing)))(logits)
+        tl = torch.tensor(np.asarray(logits), requires_grad=True)
+        tt = torch.tensor(np.asarray(labels), dtype=torch.long)
+        torch.nn.functional.cross_entropy(
+            tl, tt, label_smoothing=smoothing, reduction="sum").backward()
+        np.testing.assert_allclose(np.asarray(g), tl.grad.numpy(), atol=1e-5)
+
+    def test_padding_idx(self):
+        logits = jax.random.normal(jax.random.PRNGKey(4), (6, 10))
+        labels = jnp.array([1, 2, 0, 0, 3, 0])
+        loss = softmax_cross_entropy_loss(logits, labels, 0.0, padding_idx=0)
+        assert float(loss[2]) == 0.0 and float(loss[3]) == 0.0
+        g = jax.grad(lambda x: jnp.sum(
+            softmax_cross_entropy_loss(x, labels, 0.0, 0)))(logits)
+        np.testing.assert_array_equal(np.asarray(g[2]), 0.0)
+
+    def test_module_mean_reduction(self):
+        crit = SoftmaxCrossEntropyLoss(smoothing=0.1, padding_idx=0)
+        logits = jax.random.normal(jax.random.PRNGKey(5), (4, 7),
+                                   jnp.bfloat16)
+        labels = jnp.array([1, 0, 2, 3])
+        loss = crit(logits, labels)
+        assert loss.dtype == jnp.float32  # half_to_float
+
+
+class TestFusedDense:
+    def test_linear_bias_vs_torch(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 6, 32))
+        w = jax.random.normal(jax.random.PRNGKey(1), (16, 32)) * 0.1
+        b = jax.random.normal(jax.random.PRNGKey(2), (16,))
+        y = linear_bias(x, w, b)
+        ty = torch.nn.functional.linear(
+            torch.tensor(np.asarray(x)), torch.tensor(np.asarray(w)),
+            torch.tensor(np.asarray(b)))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
+
+    def test_dense_gelu_dense_fwd_bwd_vs_torch(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (5, 16))
+        w1 = jax.random.normal(jax.random.PRNGKey(4), (32, 16)) * 0.2
+        b1 = jax.random.normal(jax.random.PRNGKey(5), (32,)) * 0.1
+        w2 = jax.random.normal(jax.random.PRNGKey(6), (8, 32)) * 0.2
+        b2 = jax.random.normal(jax.random.PRNGKey(7), (8,)) * 0.1
+
+        y = dense_gelu_dense(x, w1, b1, w2, b2)
+        grads = jax.grad(lambda *a: jnp.sum(dense_gelu_dense(*a) ** 2),
+                         argnums=(0, 1, 2, 3, 4))(x, w1, b1, w2, b2)
+
+        tx = torch.tensor(np.asarray(x), requires_grad=True)
+        tw1 = torch.tensor(np.asarray(w1), requires_grad=True)
+        tb1 = torch.tensor(np.asarray(b1), requires_grad=True)
+        tw2 = torch.tensor(np.asarray(w2), requires_grad=True)
+        tb2 = torch.tensor(np.asarray(b2), requires_grad=True)
+        th = torch.nn.functional.linear(tx, tw1, tb1)
+        ta = torch.nn.functional.gelu(th)
+        ty = torch.nn.functional.linear(ta, tw2, tb2)
+        np.testing.assert_allclose(np.asarray(y), ty.detach().numpy(),
+                                   atol=1e-5)
+        (ty ** 2).sum().backward()
+        for g, t in zip(grads, (tx, tw1, tb1, tw2, tb2)):
+            np.testing.assert_allclose(np.asarray(g), t.grad.numpy(),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_modules_init_apply(self):
+        m = FusedDenseGeluDense(16, 32, 8)
+        x = jnp.ones((2, 16))
+        v = m.init(jax.random.PRNGKey(0), x)
+        y = m.apply(v, x)
+        assert y.shape == (2, 8)
+        m2 = FusedDense(16, 4)
+        v2 = m2.init(jax.random.PRNGKey(1), x)
+        assert m2.apply(v2, x).shape == (2, 4)
+
+
+class TestMLP:
+    @pytest.mark.parametrize("activation", ["relu", "sigmoid", "none"])
+    @pytest.mark.parametrize("use_bias", [True, False])
+    def test_vs_torch_sequential(self, activation, use_bias):
+        """Port of tests/L0/run_mlp/test_mlp.py: apex MLP vs nn.Sequential."""
+        sizes = [13, 27, 17, 5]
+        m = MLP(sizes, use_bias=use_bias, activation=activation)
+        x = jax.random.normal(jax.random.PRNGKey(0), (7, 13))
+        v = m.init(jax.random.PRNGKey(1), x)
+        y = m.apply(v, x)
+
+        layers = []
+        for i in range(len(sizes) - 1):
+            lin = torch.nn.Linear(sizes[i], sizes[i + 1], bias=use_bias)
+            with torch.no_grad():
+                lin.weight.copy_(torch.tensor(np.asarray(
+                    v["params"][f"weight_{i}"])))
+                if use_bias:
+                    lin.bias.copy_(torch.tensor(np.asarray(
+                        v["params"][f"bias_{i}"])))
+            layers.append(lin)
+            if i < len(sizes) - 2:
+                if activation == "relu":
+                    layers.append(torch.nn.ReLU())
+                elif activation == "sigmoid":
+                    layers.append(torch.nn.Sigmoid())
+        ref = torch.nn.Sequential(*layers)(torch.tensor(np.asarray(x)))
+        np.testing.assert_allclose(np.asarray(y), ref.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_grads_flow(self):
+        m = MLP([8, 16, 4])
+        x = jax.random.normal(jax.random.PRNGKey(2), (3, 8))
+        v = m.init(jax.random.PRNGKey(3), x)
+        g = jax.grad(lambda vv: jnp.sum(m.apply(vv, x) ** 2))(v)
+        for leaf in jax.tree_util.tree_leaves(g):
+            assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+class TestWgrad:
+    def test_fp32_accumulation(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (6, 4, 16),
+                              jnp.bfloat16)
+        dy = jax.random.normal(jax.random.PRNGKey(1), (6, 4, 8),
+                               jnp.bfloat16)
+        main = jnp.ones((8, 16), jnp.float32)
+        out = wgrad_gemm_accum_fp32(x, dy, main)
+        ref = np.ones((8, 16)) + np.einsum(
+            "bso,bsi->oi", np.asarray(dy, np.float32),
+            np.asarray(x, np.float32))
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-2)
