@@ -1,1 +1,32 @@
-"""contrib — TPU equivalents of ``apex/contrib`` packages (built out per SURVEY §2.3/2.4)."""
+"""contrib — TPU equivalents of the ``apex/contrib`` packages (SURVEY §2.3/2.4).
+
+Per-package mapping:
+- xentropy, focal_loss, index_mul_2d, clip_grad, transducer — fused ops
+- group_norm (NHWC+SiLU), layer_norm (FastLayerNorm), groupbn / cudnn_gbn
+  (group BatchNorm over device subgroups), bottleneck (+ spatial parallel)
+- sparsity (ASP 2:4 masks + permutation search)
+- optimizers (DistributedFusedAdam/LAMB ZeRO, FP16_Optimizer)
+- peer_memory / nccl_p2p — ppermute-backed halo facades
+- nccl_allocator / torchsched — documented no-op layers (XLA owns memory and
+  scheduling; see module docstrings)
+- openfold_triton — Pallas LN/MHA re-exports + FusedAdamSWA
+- conv_bias_relu — fused conv epilogue shims
+"""
+
+from apex_tpu.contrib import xentropy  # noqa: F401
+from apex_tpu.contrib import focal_loss  # noqa: F401
+from apex_tpu.contrib import index_mul_2d  # noqa: F401
+from apex_tpu.contrib import clip_grad  # noqa: F401
+from apex_tpu.contrib import group_norm  # noqa: F401
+from apex_tpu.contrib import layer_norm  # noqa: F401
+from apex_tpu.contrib import groupbn  # noqa: F401
+from apex_tpu.contrib import bottleneck  # noqa: F401
+from apex_tpu.contrib import transducer  # noqa: F401
+from apex_tpu.contrib import sparsity  # noqa: F401
+from apex_tpu.contrib import peer_memory  # noqa: F401
+from apex_tpu.contrib import nccl_p2p  # noqa: F401
+from apex_tpu.contrib import nccl_allocator  # noqa: F401
+from apex_tpu.contrib import torchsched  # noqa: F401
+from apex_tpu.contrib import openfold_triton  # noqa: F401
+from apex_tpu.contrib import conv_bias_relu  # noqa: F401
+from apex_tpu.contrib import optimizers  # noqa: F401

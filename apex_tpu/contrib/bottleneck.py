@@ -1,0 +1,107 @@
+"""Bottleneck / SpatialBottleneck — TPU equivalent of
+``apex/contrib/bottleneck/bottleneck.py`` (``Bottleneck`` :154,
+``SpatialBottleneck`` :833 over ``fast_bottleneck`` cuDNN fused convs,
+spatial-parallel halo entry points bottleneck.cpp:3558-3595).
+
+TPU design: the cuDNN fused conv+scale+bias+relu chains are XLA fusions; the
+spatial (H-split) parallelism keeps the reference's structure — exchange
+1-row halos with the ppermute exchangers (apex_tpu.parallel.halo, the
+peer_memory/nccl_p2p equivalent), run the 3x3 conv VALID over the
+halo-extended tile so each shard computes exactly its slice of the global
+convolution (SURVEY §3.5 call stack).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.halo import HaloExchanger, HaloExchangerPeer
+from apex_tpu.parallel.sync_batch_norm import SyncBatchNorm
+
+_f32 = jnp.float32
+
+
+class Bottleneck(nn.Module):
+    """ResNet bottleneck (1x1→3x3→1x1, expansion 4) with frozen-BN-style
+    scale/bias folded convs — the contrib Bottleneck's inference-friendly
+    form, trainable here."""
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    compute_dtype: Any = jnp.bfloat16
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       param_dtype=jnp.float32)
+        bn = partial(SyncBatchNorm, axis_name=self.bn_axis_name)
+        residual = x
+        y = conv(self.bottleneck_channels, (1, 1), name="conv1")(x)
+        y = bn(self.bottleneck_channels, name="bn1", fuse_relu=True)(
+            y, use_running_average)
+        y = conv(self.bottleneck_channels, (3, 3),
+                 strides=(self.stride,) * 2,
+                 padding=[(1, 1), (1, 1)], name="conv2")(y)
+        y = bn(self.bottleneck_channels, name="bn2", fuse_relu=True)(
+            y, use_running_average)
+        y = conv(self.out_channels, (1, 1), name="conv3")(y)
+        y = bn(self.out_channels, name="bn3")(y, use_running_average)
+        if self.in_channels != self.out_channels or self.stride != 1:
+            residual = conv(self.out_channels, (1, 1),
+                            strides=(self.stride,) * 2, name="proj")(x)
+            residual = bn(self.out_channels, name="proj_bn")(
+                residual, use_running_average)
+        return jnp.maximum(y + residual.astype(y.dtype), 0.0)
+
+
+class SpatialBottleneck(nn.Module):
+    """H-split spatially-parallel bottleneck (≈ SpatialBottleneck :833).
+
+    Input x: the LOCAL H-shard (N, H_local, W, C), sharded over
+    ``spatial_axis_name`` inside shard_map. The 3x3 conv exchanges one-row
+    halos with the configured exchanger, then convolves VALID over the
+    extended tile — numerically identical to the unsharded conv.
+    """
+
+    in_channels: int
+    bottleneck_channels: int
+    out_channels: int
+    stride: int = 1
+    spatial_axis_name: str = "spatial"
+    halo_ex: Optional[HaloExchanger] = None
+    compute_dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        assert self.stride == 1, (
+            "spatial-parallel path supports stride 1 (the reference's "
+            "halo exchange is likewise for the stride-1 3x3)")
+        halo_ex = self.halo_ex or HaloExchangerPeer(self.spatial_axis_name)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype,
+                       param_dtype=jnp.float32)
+        bn = partial(SyncBatchNorm, axis_name=self.spatial_axis_name)
+        residual = x
+        y = conv(self.bottleneck_channels, (1, 1), name="conv1")(x)
+        y = bn(self.bottleneck_channels, name="bn1", fuse_relu=True)(
+            y, use_running_average)
+        # halo exchange on H (axis 1), then VALID 3x3 == global SAME 3x3
+        y = halo_ex(y, 1, spatial_axis=1)
+        y = conv(self.bottleneck_channels, (3, 3),
+                 padding=[(0, 0), (1, 1)], name="conv2")(y)
+        y = bn(self.bottleneck_channels, name="bn2", fuse_relu=True)(
+            y, use_running_average)
+        y = conv(self.out_channels, (1, 1), name="conv3")(y)
+        y = bn(self.out_channels, name="bn3")(y, use_running_average)
+        if self.in_channels != self.out_channels:
+            residual = conv(self.out_channels, (1, 1), name="proj")(x)
+            residual = bn(self.out_channels, name="proj_bn")(
+                residual, use_running_average)
+        return jnp.maximum(y + residual.astype(y.dtype), 0.0)

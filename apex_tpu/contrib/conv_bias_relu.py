@@ -1,0 +1,54 @@
+"""Fused Conv+Bias(+ReLU/Mask) — TPU equivalent of ``fused_conv_bias_relu``
+(apex/contrib/csrc/conv_bias_relu/conv_bias_relu.cpp:1902-1911 cuDNN-frontend
+fused epilogues; frontend apex/contrib/conv_bias_relu/conv_bias_relu.py).
+
+XLA fuses conv epilogues natively on TPU, so these are thin functional shims
+whose value is API parity + guaranteed-fusable formulation (NHWC, bias add and
+activation expressed in the conv's output dtype chain).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def _conv_nhwc(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=_f32)
+
+
+def conv_bias(x, weight, bias, stride: int = 1, padding: int = 0):
+    """ConvBias (conv_bias_relu.py ConvBias_)."""
+    y = _conv_nhwc(x, weight, stride, padding) + bias.astype(_f32)
+    return y.astype(x.dtype)
+
+
+def conv_bias_relu(x, weight, bias, stride: int = 1, padding: int = 0):
+    """ConvBiasReLU — fused conv+bias+relu."""
+    y = _conv_nhwc(x, weight, stride, padding) + bias.astype(_f32)
+    return jnp.maximum(y, 0.0).astype(x.dtype)
+
+
+def conv_bias_mask_relu(x, weight, bias, mask, stride: int = 1,
+                        padding: int = 0):
+    """ConvBiasMaskReLU — fused conv+bias+elementwise-mask+relu."""
+    y = _conv_nhwc(x, weight, stride, padding) + bias.astype(_f32)
+    y = y * mask.astype(_f32)
+    return jnp.maximum(y, 0.0).astype(x.dtype)
+
+
+def conv_frozen_scale_bias_relu(x, weight, scale, bias, stride: int = 1,
+                                padding: int = 0):
+    """ConvFrozenScaleBiasReLU — conv + frozen-BN affine + relu
+    (conv_bias_relu.cpp frozen-scale-bias entry)."""
+    y = _conv_nhwc(x, weight, stride, padding)
+    y = y * scale.astype(_f32) + bias.astype(_f32)
+    return jnp.maximum(y, 0.0).astype(x.dtype)

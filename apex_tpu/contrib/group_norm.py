@@ -1,0 +1,76 @@
+"""NHWC GroupNorm with fused SiLU — TPU equivalent of the contrib GroupNorm
+stack: ``group_norm_cuda`` one/two-pass (27 instantiation files),
+``group_norm_v2_cuda`` (SM90/100), and frontend
+``apex/contrib/group_norm/group_norm.py`` (:211 module, algorithm selection
+:193-209, ``torch_group_norm`` fallback :37).
+
+TPU design: one implementation for all channel counts — XLA fuses the
+reduction + normalize + SiLU chain over the NHWC layout (the layout TPU convs
+prefer, same reason the reference targets NHWC). Stats always fp32. The
+reference's one-pass/two-pass/v2 algorithm switch and SUPPORTED_CHANNELS
+tables (:247-325) are compiler concerns on TPU and intentionally absent.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def group_norm_nhwc(x: jax.Array, num_groups: int,
+                    weight: Optional[jax.Array] = None,
+                    bias: Optional[jax.Array] = None, eps: float = 1e-5,
+                    act: str = "") -> jax.Array:
+    """x: (N, H, W, C); ``act`` in {"", "silu"} (the fused SiLU epilogue of
+    group_norm_nhwc_one_pass_*.cu)."""
+    n, h, w, c = x.shape
+    assert c % num_groups == 0
+    x32 = x.astype(_f32).reshape(n, h * w, num_groups, c // num_groups)
+    mean = jnp.mean(x32, axis=(1, 3), keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=(1, 3), keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(n, h, w, c)
+    if weight is not None:
+        y = y * weight.astype(_f32)
+    if bias is not None:
+        y = y + bias.astype(_f32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    elif act:
+        raise ValueError(f"unsupported act {act!r}")
+    return y.astype(x.dtype)
+
+
+def torch_group_norm(x, num_groups, weight=None, bias=None, eps=1e-5,
+                     act=""):
+    """Name-parity alias for the reference's fallback (group_norm.py:37)."""
+    return group_norm_nhwc(x, num_groups, weight, bias, eps, act)
+
+
+class GroupNorm(nn.Module):
+    """flax module ≈ apex.contrib.group_norm.GroupNorm (group_norm.py:211).
+
+    NHWC input; ``act='silu'`` fuses the activation.
+    """
+
+    num_groups: int
+    num_channels: int
+    eps: float = 1e-5
+    affine: bool = True
+    act: str = ""
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = b = None
+        if self.affine:
+            w = self.param("weight", nn.initializers.ones,
+                           (self.num_channels,), self.param_dtype)
+            b = self.param("bias", nn.initializers.zeros,
+                           (self.num_channels,), self.param_dtype)
+        return group_norm_nhwc(x, self.num_groups, w, b, self.eps, self.act)

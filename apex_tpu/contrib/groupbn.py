@@ -1,0 +1,99 @@
+"""Group BatchNorm NHWC — TPU equivalent of the ``bnp`` extension
+(apex/contrib/csrc/groupbn/, NHWC BatchNorm + add+ReLU fusion with cross-GPU
+group statistics over CUDA IPC, ``ipc.cu``/``interface.cpp:78``) and its
+frontend ``apex/contrib/groupbn/batch_norm.py`` (``BatchNorm2d_NHWC`` :8 with
+``bn_group``), plus the cuDNN-frontend variant ``cudnn_gbn``
+(apex/contrib/cudnn_gbn/batch_norm.py:85 ``GroupBatchNorm2d``).
+
+TPU design: the IPC peer-stat exchange becomes an ``all_gather`` restricted to
+device subgroups (``axis_index_groups``) feeding the same Welford merge
+SyncBatchNorm uses — one implementation covers syncbn (group = world), groupbn
+(group = bn_group), and plain BN (group = 1). The fused add+ReLU epilogues
+(``bn_addrelu_*``) are the ``fuse_add``/``fuse_relu`` flags below; XLA folds
+them into the normalization loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batch_norm import sync_batch_norm_stats
+
+_f32 = jnp.float32
+
+
+def _bn_groups(world: int, bn_group: int):
+    if bn_group <= 1:
+        return None
+    assert world % bn_group == 0
+    return [list(range(i, i + bn_group))
+            for i in range(0, world, bn_group)]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """≈ ``apex.contrib.groupbn.BatchNorm2d_NHWC``.
+
+    NHWC input (N, H, W, C). ``bn_group`` > 1 reduces statistics across that
+    many consecutive devices of ``axis_name`` (the IPC group of the
+    reference); ``fuse_relu`` / ``fuse_add`` mirror the bn_relu / bn_add_relu
+    fused kernels (a residual ``z`` is added before the activation).
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = None
+    world_size: int = 1
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, z: Optional[jax.Array] = None,
+                 use_running_average: bool = False):
+        c = self.num_features
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), _f32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), _f32))
+        weight = self.param("weight", nn.initializers.ones, (c,),
+                            self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, (c,),
+                          self.param_dtype)
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            axis = None if (self.is_initializing()
+                            or self.axis_name is None) else self.axis_name
+            groups = (_bn_groups(self.world_size, self.bn_group)
+                      if axis is not None else None)
+            mean, var, count = sync_batch_norm_stats(
+                x, (0, 1, 2), axis, axis_index_groups=groups)
+            if not self.is_initializing():
+                unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+                ra_mean.value = ((1 - self.momentum) * ra_mean.value
+                                 + self.momentum * mean)
+                ra_var.value = ((1 - self.momentum) * ra_var.value
+                                + self.momentum * unbiased)
+
+        y = (x.astype(_f32) - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * weight.astype(_f32) + bias.astype(_f32)
+        if z is not None:  # bn_add_relu fusion
+            y = y + z.astype(_f32)
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
+
+
+def GroupBatchNorm2d(num_features: int, group_size: int = 1,
+                     **kw) -> BatchNorm2d_NHWC:
+    """Factory ≈ ``apex.contrib.cudnn_gbn.GroupBatchNorm2d``
+    (cudnn_gbn/batch_norm.py:85) — same semantics via the cuDNN graph API in
+    the reference; identical module here (graph-API fusion is XLA's job)."""
+    kw.setdefault("bn_group", group_size)
+    return BatchNorm2d_NHWC(num_features=num_features, **kw)

@@ -1,0 +1,37 @@
+"""FastLayerNorm — TPU equivalent of the contrib ``fast_layer_norm``
+(apex/contrib/csrc/layer_norm/, template-registry keyed on dtype × hidden size
+768-65536, ln.h:154-176; frontend apex/contrib/layer_norm/layer_norm.py:8-59).
+
+On TPU the Pallas LayerNorm kernel (ops/pallas/layer_norm_kernel.py) already
+row-tiles any 128-lane-friendly hidden size — the per-hidden-size template
+registry and multi-CTA gmem barrier (ln.h:15-66) are Mosaic's job. This module
+is the contrib-API facade over the same kernel.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.normalization.fused_layer_norm import fused_layer_norm_affine
+
+
+def ln_fwd(x, gamma, beta, epsilon: float = 1e-5):
+    """Functional parity with ``fast_layer_norm.ln_fwd`` (ln_api.cpp:255)."""
+    return fused_layer_norm_affine(x, gamma, beta, x.shape[-1], epsilon)
+
+
+class FastLayerNorm(nn.Module):
+    """≈ apex.contrib.layer_norm.FastLayerNorm (hidden sizes 768-65536)."""
+
+    hidden_size: int
+    eps: float = 1e-5
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (self.hidden_size,),
+                       self.param_dtype)
+        b = self.param("bias", nn.initializers.zeros, (self.hidden_size,),
+                       self.param_dtype)
+        return fused_layer_norm_affine(x, w, b, self.hidden_size, self.eps)

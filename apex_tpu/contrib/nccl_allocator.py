@@ -1,0 +1,31 @@
+"""NCCL-registered allocator facade — reference: ``_apex_nccl_allocator``
+(apex/contrib/csrc/nccl_allocator/NCCLAllocator.cpp:40 — a
+``CUDAPluggableAllocator`` over ``ncclMemAlloc`` enabling NVLS zero-copy
+collectives; frontend apex/contrib/nccl_allocator/nccl_allocator.py:18-82).
+
+TPU status: **intentionally a no-op layer.** XLA owns all device memory and
+collective buffers are registered with the ICI fabric by the compiler —
+the capability the reference unlocks (zero-copy user-buffer collectives) is
+the default on TPU. The context-manager API is preserved so reference call
+sites (e.g. DistributedFusedAdam(nccl_ub=True) setups) port unchanged.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+def init():
+    """≈ nccl_allocator.init() (:36-38 sets NCCL_NVLS_ENABLE) — no-op."""
+
+
+def create_nccl_mem_pool(symmetric: bool = False):
+    """Returns a handle object for API parity; carries no memory."""
+    return object()
+
+
+@contextlib.contextmanager
+def nccl_mem(pool=None, enabled: bool = True, group=None):
+    """≈ ``with nccl_allocator.nccl_mem():`` (:41-82) — allocations inside
+    the context are already collective-ready on TPU; yields unchanged."""
+    yield

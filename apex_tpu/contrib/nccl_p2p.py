@@ -1,0 +1,51 @@
+"""Raw p2p communicator facade — TPU equivalent of ``nccl_p2p_cuda``
+(apex/contrib/csrc/nccl_p2p/nccl_p2p.cpp:20-28: ``get_unique_nccl_id``,
+``init_nccl_comm``, ``left_right_halo_exchange[_inplace]``, ``add_delay``).
+
+On TPU the "communicator" is the mesh axis: rendezvous is
+``jax.distributed.initialize`` + ``Mesh`` (apex_tpu.parallel.mesh), and the
+p2p exchange is ppermute. ``add_delay`` — the reference's only
+fault-injection hook (SURVEY §5) — is kept as a real latency injector for
+halo-exchange race tests.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.halo import left_right_halo_exchange  # noqa: F401
+
+
+def get_unique_nccl_id(n: int = 1):
+    """Rendezvous-id parity shim: TPU meshes need no explicit unique id —
+    jax.distributed.initialize coordinates hosts. Returns a placeholder."""
+    return jnp.zeros((n, 128), jnp.uint8)
+
+
+def init_nccl_comm(unique_id=None, my_rank: int = 0, num_ranks: int = 1,
+                   axis_name: str = "spatial"):
+    """Returns the axis name — the TPU 'communicator handle'."""
+    return axis_name
+
+
+def add_delay(delay_ms: int, x=None):
+    """Latency injection for race/ overlap tests (nccl_p2p.cpp:28).
+
+    Inside jit: burns ~delay proportional device cycles with a dependency on
+    ``x`` so the scheduler cannot elide or reorder it. On host (x=None):
+    sleeps.
+    """
+    if x is None:
+        time.sleep(delay_ms / 1e3)
+        return None
+    # device-side: a serially-dependent scan the compiler can't shortcut
+    iters = max(int(delay_ms * 1000), 1)
+
+    def body(c, _):
+        return c * 1.0000001 + 1e-7, None
+
+    acc, _ = jax.lax.scan(body, jnp.float32(1.0), None, length=iters)
+    return x + (acc * 0.0).astype(x.dtype)

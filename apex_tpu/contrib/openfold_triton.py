@@ -1,0 +1,108 @@
+"""OpenFold kernel pack — TPU equivalent of ``apex/contrib/openfold_triton/``
+(Triton LN tuned for AlphaFold shapes ``_layer_norm_*.py``, Triton fused MHA
+``_mha_kernel.py``, ``FusedAdamSWA`` — Adam + stochastic weight averaging in
+one kernel — ``fused_adam_swa.py``, autotune-cache sync ``__init__.py:32-40``).
+
+TPU mapping: the LN and MHA Triton kernels are the framework's Pallas
+LayerNorm and flash attention (re-exported here under the openfold names);
+FusedAdamSWA is implemented as one fused tree update; the Triton autotune
+cache sync has no analog (XLA compile cache is shared) — ``sync_triton_auto_tune_cache_across_gpus``
+is a documented no-op.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.normalization.fused_layer_norm import (  # noqa: F401
+    fused_layer_norm_affine as layer_norm,
+)
+from apex_tpu.optimizers.functional import adam_update
+from apex_tpu.ops.pallas.flash_attention import (  # noqa: F401
+    flash_attention as mha,
+)
+
+_f32 = jnp.float32
+
+
+def fused_adam_swa_update(params: Any, swa_params: Any, grads: Any,
+                          exp_avg: Any, exp_avg_sq: Any, *, step, lr,
+                          beta1: float = 0.9, beta2: float = 0.999,
+                          eps: float = 1e-8, weight_decay: float = 0.0,
+                          swa_decay_rate: float = 0.9,
+                          swa_n_averaged=None):
+    """One fused Adam step + EMA/SWA weight update (≈ FusedAdamSWA's single
+    kernel over both param sets). Returns
+    ``(params, swa_params, m, v, swa_n_averaged)``.
+
+    ``swa_decay_rate`` < 1 gives EMA; with ``swa_n_averaged`` given, equal-
+    weight SWA averaging is used instead (the reference supports both).
+    """
+    # Adam phase: reuse the framework's fused update (optimizers/functional)
+    p_new, m_new, v_new = adam_update(
+        params, grads, exp_avg, exp_avg_sq, step=step, lr=lr, beta1=beta1,
+        beta2=beta2, eps=eps, weight_decay=weight_decay, adam_w_mode=True,
+        bias_correction=True)
+
+    # SWA/EMA epilogue (the only FusedAdamSWA-specific math)
+    def swa_leaf(sw, p):
+        p32 = p.astype(_f32)
+        if swa_n_averaged is not None:
+            n = swa_n_averaged.astype(_f32)
+            sw_new = sw.astype(_f32) + (p32 - sw.astype(_f32)) / (n + 1.0)
+        else:
+            sw_new = (swa_decay_rate * sw.astype(_f32)
+                      + (1.0 - swa_decay_rate) * p32)
+        return sw_new.astype(sw.dtype)
+
+    sw_new = jax.tree_util.tree_map(swa_leaf, swa_params, p_new)
+    n_out = (swa_n_averaged + 1) if swa_n_averaged is not None else None
+    return p_new, sw_new, m_new, v_new, n_out
+
+
+class FusedAdamSWA:
+    """Stateful facade ≈ openfold_triton.FusedAdamSWA."""
+
+    def __init__(self, params: Any, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 swa_decay_rate: float = 0.9):
+        self._params = params
+        self._swa = jax.tree_util.tree_map(lambda p: p.astype(_f32), params)
+        self._m = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _f32), params)
+        self._v = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, _f32), params)
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.swa_decay_rate = swa_decay_rate
+        self._step = jnp.zeros((), jnp.int32)
+
+    def step(self, grads: Any):
+        self._step = self._step + 1
+        p, sw, m, v, _ = fused_adam_swa_update(
+            self._params, self._swa, grads, self._m, self._v,
+            step=self._step, lr=self.lr, beta1=self.betas[0],
+            beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay,
+            swa_decay_rate=self.swa_decay_rate)
+        self._params, self._swa, self._m, self._v = p, sw, m, v
+        return p
+
+    @property
+    def parameters(self):
+        return self._params
+
+    @property
+    def swa_parameters(self):
+        return self._swa
+
+
+def sync_triton_auto_tune_cache_across_gpus(*_a, **_kw):
+    """No-op on TPU: XLA's compilation cache is process-wide and the Mosaic
+    compiler has no per-device autotune state to synchronize
+    (reference: openfold_triton/__init__.py:32-40)."""

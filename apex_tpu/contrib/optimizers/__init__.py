@@ -1,0 +1,12 @@
+"""contrib.optimizers — the deprecated pre-amp optimizer surface + the
+distributed (ZeRO) optimizers (re-exported from apex_tpu.optimizers)."""
+
+from apex_tpu.optimizers.distributed_fused_adam import (  # noqa: F401
+    DistributedFusedAdam,
+)
+from apex_tpu.optimizers.distributed_fused_lamb import (  # noqa: F401
+    DistributedFusedLAMB,
+)
+from apex_tpu.contrib.optimizers.fp16_optimizer import (  # noqa: F401
+    FP16_Optimizer,
+)

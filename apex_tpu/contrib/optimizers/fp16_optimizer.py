@@ -1,0 +1,67 @@
+"""FP16_Optimizer — TPU equivalent of
+``apex/contrib/optimizers/fp16_optimizer.py`` (248 LoC): the master-weight
+fp32 wrapper of the deprecated contrib FusedAdam/SGD flow — flat fp32 master
+buffer, loss-scale handling, fp16 model weights written back each step.
+
+Here it wraps any apex_tpu stateful optimizer: keeps fp32 masters inside the
+wrapped optimizer (``master_weights=True`` path), adds static/dynamic loss
+scaling, and exposes the legacy ``backward(loss)``-less functional flow:
+``params = opt.step(grads_fp16)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.grad_scaler import DynamicGradScaler
+from apex_tpu.multi_tensor.functional import tree_check_finite
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = init_optimizer
+        if dynamic_loss_scale:
+            self.scaler = DynamicGradScaler(**(dynamic_loss_args or {}))
+        else:
+            self.scaler = DynamicGradScaler(
+                init_scale=static_loss_scale, growth_factor=1.0,
+                backoff_factor=1.0, growth_interval=2 ** 31 - 1)
+        self.scale_state = self.scaler.init()
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.scale_state.scale)
+
+    def scale_loss(self, loss):
+        """Multiply the loss by the current scale (legacy
+        ``optimizer.backward(loss)`` replacement: scale, then take grads)."""
+        return self.scaler.scale(loss, self.scale_state)
+
+    def step(self, grads: Any, lr=None):
+        """grads are SCALED fp16/bf16 grads; unscale+check+step+update."""
+        found_inf = tree_check_finite(grads)
+        inv = 1.0 / self.scale_state.scale
+        params = self.optimizer.step(grads, lr=lr, inv_scale=inv,
+                                     found_inf=found_inf)
+        self.scale_state = self.scaler.update(self.scale_state, found_inf)
+        return params
+
+    @property
+    def parameters(self):
+        return self.optimizer.parameters
+
+    def state_dict(self):
+        return {"optimizer": self.optimizer.state_dict(),
+                "scale": float(self.scale_state.scale)}
+
+    def load_state_dict(self, sd):
+        self.optimizer.load_state_dict(sd["optimizer"])
+        import jax.numpy as jnp
+        self.scale_state = self.scale_state._replace(
+            scale=jnp.float32(sd["scale"]))

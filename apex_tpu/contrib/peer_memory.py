@@ -1,0 +1,54 @@
+"""Peer memory pool + 1-D halo exchanger facades — TPU equivalent of
+``apex/contrib/peer_memory/`` (``PeerMemoryPool`` peer_memory.py:6-42,
+``PeerHaloExchanger1d`` peer_halo_exchanger_1d.py:5) over the
+``peer_memory_cuda`` IPC kernels (peer_memory.cpp:20-34,
+``push_pull_halos_1d``).
+
+On TPU there is no user-managed device memory: XLA owns buffers and
+chip-to-chip one-sided writes are what ``ppermute`` compiles to over ICI
+(SURVEY §5 comm backend mapping). ``PeerMemoryPool`` therefore carries only
+the bookkeeping surface (sizes/alignment) so reference call sites port
+mechanically, and the halo exchanger delegates to apex_tpu.parallel.halo.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.parallel.halo import halo_exchange_1d, left_right_halo_exchange
+
+
+class PeerMemoryPool:
+    """API-parity shim (peer_memory.py:29-42). Allocation is XLA's job; the
+    pool records the requested static/dynamic sizes for introspection."""
+
+    def __init__(self, static_size: int = 0, dynamic_size: int = 0,
+                 peer_ranks=None):
+        self.static_size = static_size
+        self.dynamic_size = dynamic_size
+        self.peer_ranks = peer_ranks
+        self.alignment = 256
+
+    def allocate_peer_tensors(self, shape, dtype, channels_last: bool,
+                              dynamic: bool):
+        raise NotImplementedError(
+            "TPU has no user-managed peer memory: use "
+            "apex_tpu.parallel.halo (ppermute lowers to direct ICI DMA).")
+
+
+class PeerHaloExchanger1d:
+    """≈ peer_halo_exchanger_1d.PeerHaloExchanger1d — ppermute-backed."""
+
+    def __init__(self, ranks=None, rank_in_group: Optional[int] = None,
+                 peer_pool: Optional[PeerMemoryPool] = None,
+                 half_halo: int = 1, axis_name: str = "spatial"):
+        self.axis_name = axis_name
+        self.half_halo = half_halo
+
+    def left_right_halo_exchange(self, left_output_halo, right_output_halo):
+        return left_right_halo_exchange(left_output_halo, right_output_halo,
+                                        self.axis_name)
+
+    def __call__(self, x, spatial_axis: int = 1):
+        return halo_exchange_1d(x, self.half_halo, self.axis_name,
+                                spatial_axis)

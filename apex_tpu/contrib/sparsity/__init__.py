@@ -1,0 +1,5 @@
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask  # noqa: F401
+from apex_tpu.contrib.sparsity.asp import ASP  # noqa: F401
+from apex_tpu.contrib.sparsity.permutation_lib import (  # noqa: F401
+    permute_channels_to_preserve_magnitude,
+)

@@ -1,0 +1,109 @@
+"""ASP (Automatic SParsity) — TPU equivalent of
+``apex/contrib/sparsity/asp.py`` (:27 class; optimizer-step mask
+re-application :269-313; ``prune_trained_model`` one-call API :431; mask
+state across checkpoints exercised by
+apex/contrib/sparsity/test/checkpointing_test_part1.py).
+
+JAX shape: masks are a pytree of booleans next to the params; pruning is
+``params * mask``; the reference's monkey-patched optimizer step becomes
+``asp.wrap_optimizer`` (re-apply masks after each step) or calling
+``asp.apply_masks`` inside a jitted train step — both keep updates inside the
+mask support exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+
+def _default_should_prune(path: str, leaf) -> bool:
+    # prune 2D+ weights (linear/conv kernels), skip biases/norm scales
+    return hasattr(leaf, "ndim") and leaf.ndim >= 2
+
+
+class ASP:
+    """Stateful facade mirroring the reference classmethod API."""
+
+    def __init__(self):
+        self.masks: Optional[Any] = None
+        self.pattern = "m4n2_1d"
+
+    # -- reference API ------------------------------------------------------
+    def init_model_for_pruning(self, params: Any,
+                               mask_calculator: str = "m4n2_1d",
+                               verbosity: int = 2,
+                               whitelist=None,
+                               allow_recompute_mask: bool = False,
+                               custom_layer_dict=None,
+                               allow_permutation: bool = False):
+        """≈ ASP.init_model_for_pruning (asp.py:88). Records the pattern and
+        the prunable-leaf structure."""
+        self.pattern = mask_calculator
+        self.masks = jax.tree_util.tree_map(
+            lambda p: jnp.ones(p.shape, bool), params)
+        return self
+
+    def compute_sparse_masks(self, params: Any):
+        """≈ ASP.compute_sparse_masks (asp.py:269): (re)compute 2:4 masks."""
+        def leaf_mask(p):
+            if _default_should_prune("", p):
+                return create_mask(p, self.pattern)
+            return jnp.ones(p.shape, bool)
+
+        self.masks = jax.tree_util.tree_map(leaf_mask, params)
+        return self.masks
+
+    def apply_masks(self, params: Any) -> Any:
+        """Zero out pruned weights (jittable)."""
+        assert self.masks is not None, "compute_sparse_masks first"
+        return jax.tree_util.tree_map(
+            lambda p, m: jnp.where(m, p, jnp.zeros_like(p)),
+            params, self.masks)
+
+    def prune_trained_model(self, params: Any, optimizer=None) -> Any:
+        """≈ ASP.prune_trained_model (asp.py:431): one call = init + compute
+        + apply. Returns pruned params (optimizer wrapping via
+        ``wrap_optimizer``)."""
+        self.init_model_for_pruning(params, self.pattern)
+        self.compute_sparse_masks(params)
+        pruned = self.apply_masks(params)
+        if optimizer is not None:
+            self.wrap_optimizer(optimizer)
+        return pruned
+
+    def wrap_optimizer(self, optimizer):
+        """Re-apply masks after every optimizer step (the reference's step
+        monkey-patch, asp.py:269-313). Uses the optimizer's
+        ``set_parameters`` protocol so flat/ZeRO optimizers push the masked
+        values into their internal master buffers too (otherwise the
+        unmasked master would be the source of truth and resurrect pruned
+        weights)."""
+        asp = self
+        orig_step = optimizer.step
+
+        def step(grads, *a, **kw):
+            params = orig_step(grads, *a, **kw)
+            pruned = asp.apply_masks(params)
+            if hasattr(optimizer, "set_parameters"):
+                optimizer.set_parameters(pruned)
+            else:
+                optimizer._params = pruned
+            return pruned
+
+        optimizer.step = step
+        return optimizer
+
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self):
+        return {"pattern": self.pattern,
+                "masks": jax.tree_util.tree_map(np.asarray, self.masks)}
+
+    def load_state_dict(self, sd):
+        self.pattern = sd["pattern"]
+        self.masks = jax.tree_util.tree_map(jnp.asarray, sd["masks"])

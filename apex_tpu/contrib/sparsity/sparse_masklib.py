@@ -1,0 +1,58 @@
+"""2:4 structured-sparsity mask calculators — TPU equivalent of
+``apex/contrib/sparsity/sparse_masklib.py`` (``m4n2_1d`` family).
+
+Mask logic is device-agnostic (SURVEY §7 step 9: TPUs don't accelerate 2:4 —
+functional parity is the goal). Patterns: ``mMnN_1d`` keeps the N
+largest-magnitude elements of every M consecutive weights along the input
+dim; ``m4n2_2d`` applies the 1d rule on 4x4 tiles in both directions
+(best-effort parity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+
+
+def _mn_1d_mask(w2: jax.Array, m: int, n: int) -> jax.Array:
+    """w2: (rows, cols) with cols % m == 0. Keep n-of-m per group by |w|."""
+    rows, cols = w2.shape
+    g = w2.reshape(rows, cols // m, m)
+    mag = jnp.abs(g.astype(_f32))
+    # rank within each group of m; keep the top n
+    order = jnp.argsort(mag, axis=-1)  # ascending
+    ranks = jnp.argsort(order, axis=-1)
+    mask = ranks >= (m - n)
+    return mask.reshape(rows, cols)
+
+
+def create_mask(tensor: jax.Array, pattern: str = "m4n2_1d") -> jax.Array:
+    """Boolean keep-mask with the same shape as ``tensor``.
+
+    Convention matches the reference: the mask is computed over the 2D view
+    (out_features, in_features·k) with groups along the last axis.
+    """
+    shape = tensor.shape
+    w2 = tensor.reshape(shape[0], -1) if tensor.ndim > 1 \
+        else tensor.reshape(1, -1)
+    if pattern.endswith("_1d"):
+        m = int(pattern[1])
+        n = int(pattern[3])
+        if w2.shape[1] % m != 0:
+            return jnp.ones(shape, bool)  # unprunable layer (ref skips too)
+        mask = _mn_1d_mask(w2, m, n)
+    elif pattern == "m4n2_2d" or pattern.endswith("_2d"):
+        m = int(pattern[1])
+        n = int(pattern[3])
+        if w2.shape[1] % m != 0 or w2.shape[0] % m != 0:
+            return jnp.ones(shape, bool)
+        row_mask = _mn_1d_mask(w2, m, n)
+        col_mask = _mn_1d_mask(w2.T, m, n).T
+        mask = row_mask & col_mask
+        # guarantee at least the 1d pattern survives
+        mask = jnp.where(jnp.sum(mask) == 0, row_mask, mask)
+    else:
+        raise ValueError(f"unknown sparsity pattern {pattern!r}")
+    return mask.reshape(shape)

@@ -1,0 +1,27 @@
+"""torchsched — reference: ``apex/contrib/torchsched/`` (2377 LoC): a
+multi-CUDA-stream inductor backend — graph partition → stream assignment
+("dwb" scheme) + cross-stream event insertion, monkey-patching
+``torch.compile`` (torchsched/__init__.py:28-81).
+
+TPU status: **no analog by design.** The capability — overlapping independent
+kernels on parallel hardware queues — is owned end-to-end by XLA's
+latency-hiding scheduler: every jitted program is a static dataflow graph and
+the compiler assigns compute/DMA/ICI queues and inserts the synchronization
+the reference's stream/event machinery hand-builds (SURVEY §7 step 9:
+"torchsched has no TPU analog — XLA schedules").
+
+What a user ports TO: just ``jax.jit``. Knobs that influence the same
+tradeoffs live in XLA flags (e.g. ``--xla_tpu_enable_latency_hiding_scheduler``,
+enabled by default on recent toolchains).
+"""
+
+BACKEND_NAME = "xla"  # parity constant: the 'backend' is the compiler itself
+
+
+def compile(fn=None, **_kw):
+    """≈ torchsched-patched ``torch.compile`` → on TPU this is ``jax.jit``."""
+    import jax
+
+    if fn is None:
+        return jax.jit
+    return jax.jit(fn)

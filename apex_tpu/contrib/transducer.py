@@ -1,0 +1,139 @@
+"""Transducer (RNN-T) joint + loss — TPU equivalent of
+``transducer_joint_cuda`` / ``transducer_loss_cuda``
+(apex/contrib/csrc/transducer/, frontend apex/contrib/transducer/transducer.py:6
+``TransducerJoint``, ``TransducerLoss``; pure-python spec
+_transducer_ref.py).
+
+TPU design notes:
+- the joint's tiled broadcast-add + fused ReLU/dropout is an XLA fusion;
+  the reference's packed-output mode (dropping pad positions) is expressed as
+  a mask (dynamic shapes don't jit).
+- the loss's alpha recursion is a linear recurrence in log space along the
+  label axis; it runs as ``lax.associative_scan`` per time step (log-domain
+  matmul-free wavefront), scanned over time — O(T) sequential depth instead
+  of the reference's per-(t,u) thread grid, which is the TPU-friendly shape.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+_NEG = -1e30
+
+
+def transducer_joint(f: jax.Array, g: jax.Array, f_len=None, g_len=None,
+                     relu: bool = False, dropout_prob: float = 0.0,
+                     key=None, mask: bool = False):
+    """Joint: f (B, T, H) + g (B, U, H) → (B, T, U, H), optional fused
+    ReLU+dropout (transducer_joint.cpp:45-47). ``mask=True`` zeroes positions
+    past (f_len, g_len) — the packed-output equivalent."""
+    h = f[:, :, None, :].astype(_f32) + g[:, None, :, :].astype(_f32)
+    if relu:
+        h = jnp.maximum(h, 0.0)
+    if dropout_prob > 0.0:
+        assert key is not None
+        keep = jax.random.bernoulli(key, 1.0 - dropout_prob, h.shape)
+        h = jnp.where(keep, h / (1.0 - dropout_prob), 0.0)
+    if mask:
+        if f_len is None and g_len is None:
+            raise ValueError(
+                "packed/masked joint needs f_len and/or g_len")
+        b, t, u, _ = h.shape
+        keep = jnp.ones((b, t, u, 1), bool)
+        if f_len is not None:
+            keep &= (jnp.arange(t)[None, :, None, None]
+                     < f_len[:, None, None, None])
+        if g_len is not None:
+            keep &= (jnp.arange(u)[None, None, :, None]
+                     < g_len[:, None, None, None])
+        h = jnp.where(keep, h, 0.0)
+    return h.astype(f.dtype)
+
+
+def _alpha_row_step(alpha_prev, blank_prev, label_prev):
+    """alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+                               alpha[t, u-1] + label[t, u-1])
+    — a log-linear recurrence along u solved with associative_scan."""
+    c = alpha_prev + blank_prev                     # (B, U) "emit from above"
+    # recurrence x[u] = logaddexp(c[u], x[u-1] + d[u]) with d[u]=label[t,u-1]
+    d = jnp.concatenate([jnp.full_like(label_prev[:, :1], _NEG),
+                         label_prev[:, :-1]], axis=1)
+
+    def combine(a, b):
+        ld1, lc1 = a
+        ld2, lc2 = b
+        return ld1 + ld2, jnp.logaddexp(lc1 + ld2, lc2)
+
+    ld, lc = jax.lax.associative_scan(combine, (d, c), axis=1)
+    return lc
+
+
+def transducer_loss(log_probs: jax.Array, labels: jax.Array,
+                    f_len: jax.Array, y_len: jax.Array,
+                    blank_idx: int = 0) -> jax.Array:
+    """RNN-T negative log-likelihood per batch element.
+
+    log_probs: (B, T, U, V) log-softmax outputs (U = max_label_len + 1);
+    labels: (B, U-1) int; f_len: (B,) valid time steps; y_len: (B,) valid
+    label lengths. Differentiable (autodiff through the scans reproduces the
+    reference's backward kernel).
+    """
+    b, t, u, v = log_probs.shape
+    lp = log_probs.astype(_f32)
+    blank = lp[..., blank_idx]                       # (B, T, U)
+    lab = jnp.take_along_axis(
+        lp[:, :, :-1, :], labels[:, None, :, None], axis=3)[..., 0]
+    lab = jnp.pad(lab, ((0, 0), (0, 0), (0, 1)), constant_values=_NEG)
+
+    # row 0 uses only label transitions: alpha[0, u] = Σ_{k<u} label[0, k]
+    lab0 = lab[:, 0]                                  # (B, U)
+    csum = jnp.cumsum(jnp.concatenate(
+        [jnp.zeros((b, 1)), lab0[:, :-1]], axis=1), axis=1)
+    alpha_row0 = csum                                 # alpha[0, u]
+
+    def step(alpha_prev, xs):
+        blank_prev, label_t = xs
+        row = _alpha_row_step(alpha_prev, blank_prev, label_t)
+        return row, alpha_prev
+
+    # scan over time t = 1..T-1; xs at t uses blank[t-1] and label[t]
+    xs = (jnp.moveaxis(blank[:, :-1], 1, 0), jnp.moveaxis(lab[:, 1:], 1, 0))
+    alpha_last, alpha_hist = jax.lax.scan(step, alpha_row0, xs)
+    # alpha_hist[i] = alpha row at t=i (for i in 0..T-2); append last
+    alpha_all = jnp.concatenate(
+        [jnp.moveaxis(alpha_hist, 0, 1), alpha_last[:, None, :]], axis=1)
+
+    # loss = -(alpha[f_len-1, y_len] + blank[f_len-1, y_len])
+    ti = jnp.clip(f_len - 1, 0, t - 1)
+    ui = jnp.clip(y_len, 0, u - 1)
+    gather = alpha_all[jnp.arange(b), ti, ui] + blank[jnp.arange(b), ti, ui]
+    return -gather
+
+
+class TransducerJoint:
+    """Module-style facade ≈ apex.contrib.transducer.TransducerJoint."""
+
+    def __init__(self, pack_output: bool = False, relu: bool = False,
+                 dropout: bool = False, dropout_prob: float = 0.0):
+        self.relu = relu
+        self.dropout_prob = dropout_prob if dropout else 0.0
+        self.pack_output = pack_output
+
+    def __call__(self, f, g, f_len=None, g_len=None, key=None):
+        return transducer_joint(f, g, f_len, g_len, relu=self.relu,
+                                dropout_prob=self.dropout_prob, key=key,
+                                mask=self.pack_output)
+
+
+class TransducerLoss:
+    """Module-style facade ≈ apex.contrib.transducer.TransducerLoss."""
+
+    def __init__(self, packed_input: bool = False):
+        del packed_input  # mask-based here
+
+    def __call__(self, x, label, f_len, y_len, blank_idx: int = 0):
+        return transducer_loss(x, label, f_len, y_len, blank_idx)

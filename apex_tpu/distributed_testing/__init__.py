@@ -1,0 +1,5 @@
+from apex_tpu.distributed_testing.distributed_test_base import (  # noqa: F401
+    DistributedTestBase,
+    NcclDistributedTestBase,
+    UccDistributedTestBase,
+)

@@ -43,7 +43,7 @@ class Bottleneck(nn.Module):
         y = bn(self.features, name="bn1", fuse_relu=True)(
             y, use_running_average)
         y = conv(self.features, (3, 3), strides=(self.strides,) * 2,
-                 name="conv2")(y)
+                 padding=[(1, 1), (1, 1)], name="conv2")(y)
         y = bn(self.features, name="bn2", fuse_relu=True)(
             y, use_running_average)
         y = conv(self.features * 4, (1, 1), name="conv3")(y)

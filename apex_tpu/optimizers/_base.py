@@ -84,6 +84,12 @@ class FusedOptimizerBase:
     def zero_grad(self, set_to_none: bool = True):
         """No-op: grads are function outputs in JAX (kept for API parity)."""
 
+    def set_parameters(self, params: Any):
+        """Overwrite the optimizer's view of the params (e.g. after external
+        pruning/masking). Subclasses with internal flat buffers override to
+        keep those in sync."""
+        self._params = params
+
     def state_dict(self) -> Dict[str, Any]:
         """Checkpointable state (host numpy), ≈ torch ``state_dict``."""
         return {

@@ -105,6 +105,16 @@ class FusedAdam(FusedOptimizerBase):
         self._params = unflatten(p, self._spec)
         return self._params
 
+    def set_parameters(self, params):
+        super().set_parameters(params)
+        if self.use_flat:
+            self._flat_p = flatten(params, self._spec,
+                                   dtype=self._flat_p.dtype, pad_to=1024)
+        if self.master_weights and "master" in self.state:
+            import jax as _jax
+            self.state["master"] = _jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), params)
+
     def state_dict(self):
         sd = super().state_dict()
         if self.use_flat:

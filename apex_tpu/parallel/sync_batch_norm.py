@@ -37,8 +37,13 @@ def _welford_merge(mean_a, m2_a, n_a, mean_b, m2_b, n_b):
 
 
 def sync_batch_norm_stats(x: jax.Array, reduce_axes: Sequence[int],
-                          axis_name: Optional[str] = None):
+                          axis_name: Optional[str] = None,
+                          axis_index_groups=None):
     """Cross-replica Welford mean/var over ``reduce_axes`` (+ the device axis).
+
+    ``axis_index_groups`` restricts the reduction to device subgroups — the
+    ``bn_group`` semantics of the contrib group BN (groupbn/batch_norm.py) and
+    the process-group subsets of tests/distributed/synced_batchnorm/test_groups.py.
 
     Returns ``(mean, var_biased, count_total)`` in fp32, shaped like the
     non-reduced (channel) dims.
@@ -59,8 +64,10 @@ def sync_batch_norm_stats(x: jax.Array, reduce_axes: Sequence[int],
 
     # gather per-device stats and merge pairwise (stable, order-independent
     # up to fp error — same structure as the reference's parallel merge)
-    means = jax.lax.all_gather(mean_l, axis_name)   # (world, C)
-    m2s = jax.lax.all_gather(m2_l, axis_name)
+    means = jax.lax.all_gather(mean_l, axis_name,
+                               axis_index_groups=axis_index_groups)
+    m2s = jax.lax.all_gather(m2_l, axis_name,
+                             axis_index_groups=axis_index_groups)
     world = means.shape[0]
     counts = jnp.full((world,), n_local, _f32)
 

@@ -1,0 +1,375 @@
+"""Contrib package tests — the in-package test pattern of
+apex/contrib/test/<pkg>/test_*.py (every package gets coverage; parity vs
+python/torch references)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.clip_grad import clip_grad_norm_
+from apex_tpu.contrib.conv_bias_relu import (conv_bias, conv_bias_mask_relu,
+                                             conv_bias_relu)
+from apex_tpu.contrib.focal_loss import focal_loss
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.contrib.index_mul_2d import index_mul_2d
+from apex_tpu.contrib.layer_norm import FastLayerNorm
+from apex_tpu.contrib.openfold_triton import FusedAdamSWA
+from apex_tpu.contrib.optimizers import FP16_Optimizer
+from apex_tpu.contrib.sparsity import ASP, create_mask
+from apex_tpu.contrib.transducer import (TransducerJoint, transducer_joint,
+                                         transducer_loss)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.parallel import get_mesh
+
+
+class TestClipGrad:
+    def test_vs_torch(self):
+        grads = [jax.random.normal(jax.random.PRNGKey(i), (7, 5)) * 3
+                 for i in range(3)]
+        clipped, total = clip_grad_norm_(grads, 1.0)
+        tg = [torch.nn.Parameter(torch.tensor(np.asarray(g)))
+              for g in grads]
+        for p, g in zip(tg, grads):
+            p.grad = torch.tensor(np.asarray(g))
+        tnorm = torch.nn.utils.clip_grad_norm_(tg, 1.0)
+        np.testing.assert_allclose(float(total), float(tnorm), rtol=1e-5)
+        for a, b in zip(clipped, tg):
+            np.testing.assert_allclose(np.asarray(a), b.grad.numpy(),
+                                       atol=1e-6)
+
+    def test_no_clip_when_under(self):
+        grads = [jnp.ones((4,)) * 0.01]
+        clipped, total = clip_grad_norm_(grads, 10.0)
+        np.testing.assert_allclose(np.asarray(clipped[0]),
+                                   np.asarray(grads[0]), rtol=1e-6)
+
+
+class TestFocalLoss:
+    def test_matches_manual_sigmoid_focal(self):
+        k = 5
+        logits = jax.random.normal(jax.random.PRNGKey(0), (8, k))
+        targets = jnp.array([0, 1, 2, -1, 5, 3, 0, 2])  # -1 ignore
+        npos = jnp.float32(4.0)
+        loss = focal_loss(logits, targets, npos, k, 0.25, 2.0, 0.0)
+        # manual reference
+        x = np.asarray(logits, np.float64)
+        t = np.asarray(targets)
+        onehot = np.zeros((8, k))
+        for i, ti in enumerate(t):
+            if ti >= 1:
+                onehot[i, ti - 1] = 1.0
+        p = 1 / (1 + np.exp(-x))
+        ce = -(onehot * np.log(p) + (1 - onehot) * np.log(1 - p))
+        pt = p * onehot + (1 - p) * (1 - onehot)
+        at = 0.25 * onehot + 0.75 * (1 - onehot)
+        per = at * (1 - pt) ** 2 * ce
+        per[t < 0] = 0.0
+        ref = per.sum() / 4.0
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-4)
+
+    def test_grad_finite_and_zero_for_ignored(self):
+        k = 4
+        logits = jax.random.normal(jax.random.PRNGKey(1), (6, k))
+        targets = jnp.array([1, -1, 2, 0, 4, -1])
+        g = jax.grad(lambda x: focal_loss(x, targets, jnp.float32(3), k))(
+            logits)
+        assert bool(jnp.all(jnp.isfinite(g)))
+        np.testing.assert_array_equal(np.asarray(g[1]), 0.0)
+        np.testing.assert_array_equal(np.asarray(g[5]), 0.0)
+
+
+class TestIndexMul2d:
+    def test_forward_and_double_backward(self):
+        in1 = jax.random.normal(jax.random.PRNGKey(0), (10, 4))
+        in2 = jax.random.normal(jax.random.PRNGKey(1), (6, 4))
+        idx = jnp.array([0, 3, 3, 9, 1, 0])
+        out = index_mul_2d(in1, in2, idx)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(in1)[np.asarray(idx)]
+                                   * np.asarray(in2), rtol=1e-6)
+        # scatter-add grad for repeated indices
+        g1 = jax.grad(lambda a: jnp.sum(index_mul_2d(a, in2, idx)))(in1)
+        row0 = np.asarray(in2)[0] + np.asarray(in2)[5]  # idx 0 twice
+        np.testing.assert_allclose(np.asarray(g1[0]), row0, rtol=1e-6)
+        # double backward exists
+        h = jax.hessian(
+            lambda a: jnp.sum(index_mul_2d(a, in2, idx) ** 2))(in1[:2])
+        assert np.all(np.isfinite(np.asarray(h)))
+
+
+class TestGroupNorm:
+    def test_vs_torch(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 16))
+        w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (16,))
+        b = 0.1 * jax.random.normal(jax.random.PRNGKey(2), (16,))
+        y = group_norm_nhwc(x, 4, w, b)
+        tx = torch.tensor(np.asarray(x)).permute(0, 3, 1, 2)
+        ty = torch.nn.functional.group_norm(
+            tx, 4, torch.tensor(np.asarray(w)), torch.tensor(np.asarray(b)))
+        np.testing.assert_allclose(np.asarray(y),
+                                   ty.permute(0, 2, 3, 1).numpy(),
+                                   atol=1e-5)
+
+    def test_fused_silu(self):
+        x = jax.random.normal(jax.random.PRNGKey(3), (1, 2, 2, 8))
+        y = group_norm_nhwc(x, 2, None, None, act="silu")
+        y0 = group_norm_nhwc(x, 2, None, None)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(y0 * jax.nn.sigmoid(y0)), atol=1e-6)
+
+    def test_module(self):
+        m = GroupNorm(num_groups=2, num_channels=8, act="silu")
+        x = jnp.ones((1, 2, 2, 8))
+        v = m.init(jax.random.PRNGKey(0), x)
+        assert m.apply(v, x).shape == x.shape
+
+
+class TestFastLayerNorm:
+    def test_matches_torch(self):
+        m = FastLayerNorm(hidden_size=256)
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 256))
+        v = m.init(jax.random.PRNGKey(1), x)
+        y = m.apply(v, x)
+        ty = torch.nn.functional.layer_norm(torch.tensor(np.asarray(x)),
+                                            (256,))
+        np.testing.assert_allclose(np.asarray(y), ty.numpy(), atol=1e-5)
+
+
+class TestGroupBN:
+    def test_bn_group_subsets(self):
+        """bn_group=4 on an 8-device axis: stats reduced within each half
+        (the test_groups.py scenario)."""
+        mesh = get_mesh("data")
+        C = 6
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 2, 2, C))
+        bn = BatchNorm2d_NHWC(num_features=C, axis_name="data", bn_group=4,
+                              world_size=8)
+        v = bn.init(jax.random.PRNGKey(1), x[:2])
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=(P(), P("data")),
+                           out_specs=P("data"), check_vma=False)
+        def apply(v, xb):
+            y, _ = bn.apply(v, xb, use_running_average=False,
+                            mutable=["batch_stats"])
+            return y
+
+        y = apply(v, x)
+        yn = np.asarray(y)
+        # normalize first half with first-half stats == zero mean per group
+        first = yn[:8].reshape(-1, C)
+        np.testing.assert_allclose(first.mean(0), 0.0, atol=1e-4)
+
+    def test_fuse_add_relu(self):
+        C = 4
+        x = jax.random.normal(jax.random.PRNGKey(2), (4, 2, 2, C))
+        z = jax.random.normal(jax.random.PRNGKey(3), (4, 2, 2, C))
+        bn = BatchNorm2d_NHWC(num_features=C, fuse_relu=True)
+        v = bn.init(jax.random.PRNGKey(4), x)
+        y, _ = bn.apply(v, x, z, use_running_average=False,
+                        mutable=["batch_stats"])
+        assert float(np.asarray(y).min()) >= 0.0
+
+
+class TestConvBiasReLU:
+    def test_matches_composed(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 3))
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 3, 8)) * 0.1
+        b = jax.random.normal(jax.random.PRNGKey(2), (8,)) * 0.1
+        y = conv_bias_relu(x, w, b, stride=1, padding=1)
+        y0 = conv_bias(x, w, b, stride=1, padding=1)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.maximum(np.asarray(y0), 0), atol=1e-6)
+        mask = (jax.random.uniform(jax.random.PRNGKey(3),
+                                   y0.shape) > 0.5).astype(jnp.float32)
+        ym = conv_bias_mask_relu(x, w, b, mask, stride=1, padding=1)
+        np.testing.assert_allclose(
+            np.asarray(ym), np.maximum(np.asarray(y0) * np.asarray(mask), 0),
+            atol=1e-6)
+
+
+class TestTransducer:
+    def test_joint(self):
+        f = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 4))
+        g = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 4))
+        h = transducer_joint(f, g)
+        ref = np.asarray(f)[:, :, None, :] + np.asarray(g)[:, None, :, :]
+        np.testing.assert_allclose(np.asarray(h), ref, atol=1e-6)
+        hr = TransducerJoint(relu=True)(f, g)
+        np.testing.assert_allclose(np.asarray(hr), np.maximum(ref, 0),
+                                   atol=1e-6)
+
+    def test_loss_matches_bruteforce(self):
+        """Enumerate all monotone alignments for a tiny case."""
+        T, U, V = 3, 3, 4  # 2 labels
+        key = jax.random.PRNGKey(0)
+        logits = jax.random.normal(key, (1, T, U, V))
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        labels = jnp.array([[1, 2]])
+        loss = transducer_loss(lp, labels, jnp.array([T]), jnp.array([U - 1]))
+
+        lpn = np.asarray(lp[0], np.float64)
+        lab = [1, 2]
+        # brute force: paths of T blanks + U-1 labels
+        import itertools
+        total = -np.inf
+        steps = ["B"] * T + ["L"] * (U - 1)
+        for perm in set(itertools.permutations(steps)):
+            t = u = 0
+            logp = 0.0
+            ok = True
+            for s in perm:
+                if s == "B":
+                    if t >= T:
+                        ok = False
+                        break
+                    logp += lpn[t, u, 0]
+                    t += 1
+                else:
+                    if u >= U - 1 or t >= T:
+                        ok = False
+                        break
+                    logp += lpn[t, u, lab[u]]
+                    u += 1
+            # must consume exactly T blanks ending at t==T (last blank from
+            # (T-1, U-1)); standard RNNT: path ends after blank at (T-1,U-1)
+            if ok and t == T and u == U - 1:
+                total = np.logaddexp(total, logp)
+        np.testing.assert_allclose(float(loss[0]), -total, rtol=1e-4)
+
+    def test_loss_grad_finite(self):
+        lp = jax.nn.log_softmax(
+            jax.random.normal(jax.random.PRNGKey(1), (2, 4, 3, 5)), axis=-1)
+        labels = jnp.array([[1, 2], [3, 4]])
+        g = jax.grad(lambda x: jnp.sum(transducer_loss(
+            x, labels, jnp.array([4, 3]), jnp.array([2, 2]))))(lp)
+        assert bool(jnp.all(jnp.isfinite(g)))
+
+
+class TestASP:
+    def test_mask_is_2_of_4(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (8, 16))
+        m = create_mask(w, "m4n2_1d")
+        mn = np.asarray(m).reshape(8, 4, 4)
+        np.testing.assert_array_equal(mn.sum(-1), 2)
+        # keeps the two largest magnitudes per group
+        wn = np.abs(np.asarray(w)).reshape(8, 4, 4)
+        kept = np.sort(np.where(mn, wn, 0).sum(-1))
+        top2 = np.sort(np.sort(wn, axis=-1)[..., -2:].sum(-1))
+        np.testing.assert_allclose(kept, top2, rtol=1e-6)
+
+    def test_prune_and_optimizer_wrap(self):
+        params = [jax.random.normal(jax.random.PRNGKey(0), (8, 8)),
+                  jax.random.normal(jax.random.PRNGKey(1), (8,))]
+        asp = ASP()
+        opt = FusedAdam(params, lr=0.1)
+        pruned = asp.prune_trained_model(params, opt)
+        opt._params = pruned
+        m = np.asarray(asp.masks[0])
+        assert m.sum() == m.size // 2
+        assert np.asarray(asp.masks[1]).all()  # 1-D not pruned
+        p = opt.step([jnp.ones((8, 8)), jnp.ones((8,))])
+        # pruned positions stay exactly zero after the step
+        np.testing.assert_array_equal(np.asarray(p[0])[~m], 0.0)
+
+    def test_checkpoint_roundtrip(self):
+        params = [jax.random.normal(jax.random.PRNGKey(2), (4, 8))]
+        asp = ASP()
+        asp.init_model_for_pruning(params)
+        asp.compute_sparse_masks(params)
+        sd = asp.state_dict()
+        asp2 = ASP()
+        asp2.load_state_dict(sd)
+        np.testing.assert_array_equal(np.asarray(asp.masks[0]),
+                                      np.asarray(asp2.masks[0]))
+
+
+class TestFusedAdamSWA:
+    def test_ema_tracks_params(self):
+        params = [jnp.ones((16,))]
+        opt = FusedAdamSWA(params, lr=0.1, swa_decay_rate=0.5)
+        for _ in range(5):
+            opt.step([jnp.ones((16,))])
+        p = float(np.asarray(opt.parameters[0])[0])
+        s = float(np.asarray(opt.swa_parameters[0])[0])
+        assert p < 1.0 and p < s < 1.0  # EMA lags the moving params
+
+
+class TestFP16Optimizer:
+    def test_dynamic_scaling_flow(self):
+        params = [jnp.ones((8,), jnp.float32)]
+        opt = FP16_Optimizer(FusedAdam(params, lr=0.1),
+                             dynamic_loss_scale=True,
+                             dynamic_loss_args={"init_scale": 64.0})
+        scaled_grads = [jnp.full((8,), 64.0)]  # true grad 1.0
+        p = opt.step(scaled_grads)
+        assert not np.allclose(np.asarray(p[0]), 1.0)
+        bad = [jnp.full((8,), jnp.inf)]
+        p2 = opt.step(bad)
+        np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(p[0]))
+        assert opt.loss_scale == 32.0
+
+
+class TestASPFlatOptimizers:
+    def test_flat_fused_adam_respects_masks(self):
+        params = [jax.random.normal(jax.random.PRNGKey(0), (8, 8))]
+        asp = ASP()
+        opt = FusedAdam(params, lr=0.1, use_flat=True)
+        pruned = asp.prune_trained_model(params, opt)
+        opt.set_parameters(pruned)
+        m = np.asarray(asp.masks[0])
+        p = opt.step([jnp.ones((8, 8))])
+        np.testing.assert_array_equal(np.asarray(p[0])[~m], 0.0)
+        # a second step keeps the internal flat master masked too
+        p = opt.step([jnp.ones((8, 8))])
+        np.testing.assert_array_equal(np.asarray(p[0])[~m], 0.0)
+
+    def test_zero_adam_respects_masks(self):
+        from apex_tpu.optimizers.distributed_fused_adam import (
+            DistributedFusedAdam)
+        mesh = get_mesh("data")
+        params = [jax.random.normal(jax.random.PRNGKey(1), (8, 16))]
+        asp = ASP()
+        opt = DistributedFusedAdam(params, mesh, lr=0.1)
+        pruned = asp.prune_trained_model(params, opt)
+        opt.set_parameters(pruned)
+        m = np.asarray(asp.masks[0])
+        p = opt.step([jnp.ones((8, 16))])
+        np.testing.assert_array_equal(np.asarray(p[0])[~m], 0.0)
+
+
+class TestSpatialBottleneck:
+    def test_matches_unsharded_bottleneck(self):
+        """H-sharded SpatialBottleneck == Bottleneck on the full input
+        (the reference's spatial-parallel correctness property)."""
+        from apex_tpu.contrib.bottleneck import Bottleneck, SpatialBottleneck
+        mesh = get_mesh("spatial")
+        C_in, C_mid, C_out = 8, 4, 8
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8 * 4, 6, C_in),
+                              jnp.float32)
+        full = Bottleneck(C_in, C_mid, C_out, compute_dtype=jnp.float32)
+        vfull = full.init(jax.random.PRNGKey(1), x)
+        sp = SpatialBottleneck(C_in, C_mid, C_out,
+                               compute_dtype=jnp.float32,
+                               spatial_axis_name="spatial")
+        # same param shapes/names → reuse the full variables
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P(), P(None, "spatial")),
+                           out_specs=P(None, "spatial"), check_vma=False)
+        def run(v, xb):
+            y, _ = sp.apply(v, xb, use_running_average=False,
+                            mutable=["batch_stats"])
+            return y
+
+        y_sp = run(vfull, x)
+        y_full, _ = full.apply(vfull, x, use_running_average=False,
+                               mutable=["batch_stats"])
+        np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_full),
+                                   atol=1e-4, rtol=1e-4)
