@@ -1,0 +1,153 @@
+// apex_tpu native runtime helpers — the C++ layer of the framework.
+//
+// Reference analog: the host-side C++ of apex — `apex_C`
+// (csrc/flatten_unflatten.cpp: flatten/unflatten under flat-bucket DDP) and
+// the chunk/bucket planning embedded in csrc/multi_tensor_apply.cuh:13-23
+// (packing tensor fragments into launch-sized groups) plus the
+// ParameterFragment range bookkeeping of
+// apex/contrib/optimizers/distributed_fused_adam.py:389-414.
+//
+// On TPU the device-side work is XLA/Pallas; what stays host-side and
+// latency-sensitive is the PLANNING over very large parameter lists
+// (hundreds of thousands of leaves for big models — quadratic/slow in
+// Python) and bulk host-memory packing for checkpoint/data staging. Exposed
+// via a plain C ABI consumed with ctypes (no pybind11 in this image).
+//
+// Build: apex_tpu/_native/build.py (gcc -O3 -shared -fPIC). Every entry point
+// has a pure-Python fallback in apex_tpu/utils/flatten.py — the native path
+// is an accelerator, not a requirement.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Compute 128-lane-aligned offsets for packing `n` leaves of `sizes[i]`
+// elements into one flat buffer. Writes offsets[n], padded[n]; returns the
+// total padded size. (= FlatSpec planning, utils/flatten.py:flat_spec)
+int64_t plan_flat(const int64_t* sizes, int64_t n, int64_t align,
+                  int64_t* offsets, int64_t* padded) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t sz = sizes[i] > 0 ? sizes[i] : 1;
+    int64_t pad = (sz + align - 1) / align * align;
+    offsets[i] = off;
+    padded[i] = pad;
+    off += pad;
+  }
+  return off;
+}
+
+// Greedy per-dtype bucket assignment for flat-bucket gradient all-reduce
+// (= apex.parallel DDP message_size bucketing; parallel/ddp.py
+// _bucket_leaves). dtype_ids[i] groups leaves; buckets fill in order to
+// >= message_size elements. Writes bucket_ids[n]; returns bucket count.
+int64_t plan_buckets(const int64_t* sizes, const int32_t* dtype_ids,
+                     int64_t n, int64_t message_size, int32_t* bucket_ids) {
+  // stable per-dtype accumulation, preserving leaf order within a dtype
+  std::vector<int32_t> seen_dtypes;
+  int64_t next_bucket = 0;
+  for (size_t pass = 0; pass < (size_t)n; ++pass) {
+    // find dtypes in first-appearance order
+    int32_t dt = dtype_ids[pass];
+    bool found = false;
+    for (int32_t s : seen_dtypes)
+      if (s == dt) { found = true; break; }
+    if (!found) seen_dtypes.push_back(dt);
+  }
+  for (int32_t dt : seen_dtypes) {
+    int64_t cur = -1, cur_n = 0;
+    for (int64_t i = 0; i < n; ++i) {
+      if (dtype_ids[i] != dt) continue;
+      if (cur < 0) cur = next_bucket++;
+      bucket_ids[i] = (int32_t)cur;
+      cur_n += sizes[i] > 0 ? sizes[i] : 1;
+      if (cur_n >= message_size) { cur = -1; cur_n = 0; }
+    }
+  }
+  return next_bucket;
+}
+
+// Multithreaded gather of `n` host arrays into one contiguous buffer at the
+// planned offsets (bytes). The host-side "flatten" for checkpoint assembly /
+// input staging (apex_C.flatten's role for host tensors).
+void pack_bytes(const uint8_t** srcs, const int64_t* nbytes,
+                const int64_t* dst_offsets, int64_t n, uint8_t* dst,
+                int32_t num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  auto worker = [&](int64_t t0, int64_t t1) {
+    for (int64_t i = t0; i < t1; ++i)
+      std::memcpy(dst + dst_offsets[i], srcs[i], (size_t)nbytes[i]);
+  };
+  if (num_threads == 1 || n < 4) {
+    worker(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + num_threads - 1) / num_threads;
+  for (int32_t t = 0; t < num_threads; ++t) {
+    int64_t a = t * chunk, b = a + chunk > n ? n : a + chunk;
+    if (a >= b) break;
+    threads.emplace_back(worker, a, b);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// Scatter back (host-side unflatten).
+void unpack_bytes(const uint8_t* src, const int64_t* src_offsets,
+                  const int64_t* nbytes, int64_t n, uint8_t** dsts,
+                  int32_t num_threads) {
+  if (num_threads < 1) num_threads = 1;
+  auto worker = [&](int64_t t0, int64_t t1) {
+    for (int64_t i = t0; i < t1; ++i)
+      std::memcpy(dsts[i], src + src_offsets[i], (size_t)nbytes[i]);
+  };
+  if (num_threads == 1 || n < 4) {
+    worker(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int64_t chunk = (n + num_threads - 1) / num_threads;
+  for (int32_t t = 0; t < num_threads; ++t) {
+    int64_t a = t * chunk, b = a + chunk > n ? n : a + chunk;
+    if (a >= b) break;
+    threads.emplace_back(worker, a, b);
+  }
+  for (auto& th : threads) th.join();
+}
+
+// ZeRO fragment bookkeeping (ParameterFragment math,
+// distributed_fused_adam.py:389-414): for each leaf [offset, offset+size)
+// in the flat buffer and a world of `world` equal shards of `shard_size`,
+// emit per-leaf per-shard overlap ranges:
+//   frag_shard[i], frag_leaf_begin[i], frag_leaf_end[i] (leaf-local),
+//   frag_shard_begin[i] (shard-local). Returns fragment count (call once
+//   with out=nullptr to size the buffers).
+int64_t plan_fragments(const int64_t* offsets, const int64_t* sizes,
+                       int64_t n, int64_t shard_size, int32_t* frag_leaf,
+                       int32_t* frag_shard, int64_t* leaf_begin,
+                       int64_t* leaf_end, int64_t* shard_begin) {
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t beg = offsets[i], end = offsets[i] + sizes[i];
+    for (int64_t s = beg / shard_size; s * shard_size < end; ++s) {
+      int64_t sb = s * shard_size, se = sb + shard_size;
+      int64_t ob = beg > sb ? beg : sb;
+      int64_t oe = end < se ? end : se;
+      if (oe <= ob) continue;
+      if (frag_leaf) {
+        frag_leaf[count] = (int32_t)i;
+        frag_shard[count] = (int32_t)s;
+        leaf_begin[count] = ob - beg;
+        leaf_end[count] = oe - beg;
+        shard_begin[count] = ob - sb;
+      }
+      ++count;
+    }
+  }
+  return count;
+}
+
+}  // extern "C"

@@ -1,0 +1,8 @@
+from apex_tpu._native.build import get_lib, native_available  # noqa: F401
+from apex_tpu._native.api import (  # noqa: F401
+    pack_arrays,
+    plan_buckets,
+    plan_flat,
+    plan_fragments,
+    unpack_arrays,
+)

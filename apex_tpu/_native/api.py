@@ -1,0 +1,180 @@
+"""Python API over the native helpers, with pure-Python fallbacks.
+
+Used by utils.flatten (flat planning), parallel.ddp (bucket planning), and
+checkpoint staging (pack/unpack). Each function works identically with or
+without the compiled library.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu._native.build import get_lib
+
+
+def plan_flat(sizes: Sequence[int], align: int = 128
+              ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Returns (offsets, padded_sizes, total)."""
+    n = len(sizes)
+    lib = get_lib()
+    sizes_a = np.asarray(sizes, np.int64)
+    offsets = np.empty(n, np.int64)
+    padded = np.empty(n, np.int64)
+    if lib is not None and n:
+        total = lib.plan_flat(
+            sizes_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, align,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            padded.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return offsets, padded, int(total)
+    off = 0
+    for i, s in enumerate(sizes_a):
+        s = max(int(s), 1)
+        p = (s + align - 1) // align * align
+        offsets[i] = off
+        padded[i] = p
+        off += p
+    return offsets, padded, off
+
+
+def plan_buckets(sizes: Sequence[int], dtype_ids: Sequence[int],
+                 message_size: int) -> Tuple[np.ndarray, int]:
+    """Returns (bucket_id per leaf, n_buckets) — per-dtype greedy fill."""
+    n = len(sizes)
+    lib = get_lib()
+    sizes_a = np.asarray(sizes, np.int64)
+    dts = np.asarray(dtype_ids, np.int32)
+    out = np.empty(n, np.int32)
+    if lib is not None and n:
+        nb = lib.plan_buckets(
+            sizes_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n,
+            message_size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return out, int(nb)
+    # python fallback (mirror of the C logic)
+    next_bucket = 0
+    seen = []
+    for d in dts:
+        if d not in seen:
+            seen.append(d)
+    for d in seen:
+        cur, cur_n = -1, 0
+        for i in range(n):
+            if dts[i] != d:
+                continue
+            if cur < 0:
+                cur = next_bucket
+                next_bucket += 1
+            out[i] = cur
+            cur_n += max(int(sizes_a[i]), 1)
+            if cur_n >= message_size:
+                cur, cur_n = -1, 0
+    return out, next_bucket
+
+
+def pack_arrays(arrays: Sequence[np.ndarray], offsets_bytes: Sequence[int],
+                total_bytes: int, num_threads: int = 0) -> np.ndarray:
+    """Gather host arrays into one byte buffer (threaded memcpy)."""
+    lib = get_lib()
+    n = len(arrays)
+    # zero-filled so alignment-padding gaps are deterministic (checkpoint
+    # buffers get hashed/compared)
+    dst = np.zeros(total_bytes, np.uint8)
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    if lib is not None and n:
+        srcs = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+        nbytes = np.asarray([a.nbytes for a in arrays], np.int64)
+        offs = np.asarray(offsets_bytes, np.int64)
+        nt = num_threads or min(os.cpu_count() or 1, 8)
+        lib.pack_bytes(
+            ctypes.cast(srcs, ctypes.POINTER(ctypes.c_void_p)),
+            nbytes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), nt)
+        return dst
+    for a, off in zip(arrays, offsets_bytes):
+        dst[off:off + a.nbytes] = a.view(np.uint8).ravel()
+    return dst
+
+
+def unpack_arrays(buf: np.ndarray, offsets_bytes: Sequence[int],
+                  shapes: Sequence[tuple], dtypes: Sequence,
+                  num_threads: int = 0) -> List[np.ndarray]:
+    """Scatter a byte buffer back into arrays (threaded memcpy when native)."""
+    n = len(offsets_bytes)
+    outs = []
+    nbytes = []
+    for shape, dt in zip(shapes, dtypes):
+        count = int(np.prod(shape)) if shape else 1
+        outs.append(np.empty(shape, dt))
+        nbytes.append(count * np.dtype(dt).itemsize)
+    lib = get_lib()
+    buf = np.ascontiguousarray(buf)
+    if lib is not None and n:
+        dsts = (ctypes.c_void_p * n)(*[o.ctypes.data for o in outs])
+        nb = np.asarray(nbytes, np.int64)
+        offs = np.asarray(offsets_bytes, np.int64)
+        nt = num_threads or min(os.cpu_count() or 1, 8)
+        lib.unpack_bytes(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            nb.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+            ctypes.cast(dsts, ctypes.POINTER(ctypes.c_void_p)), nt)
+        return outs
+    for o, off, nb_i in zip(outs, offsets_bytes, nbytes):
+        o.view(np.uint8).reshape(-1)[:] = buf[off:off + nb_i]
+    return outs
+
+
+def plan_fragments(offsets: Sequence[int], sizes: Sequence[int],
+                   shard_size: int):
+    """ZeRO fragment table: per (leaf × shard) overlap ranges.
+
+    Returns dict of arrays: leaf, shard, leaf_begin, leaf_end, shard_begin.
+    """
+    n = len(offsets)
+    lib = get_lib()
+    offs = np.asarray(offsets, np.int64)
+    szs = np.asarray(sizes, np.int64)
+    if lib is not None and n:
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        count = lib.plan_fragments(
+            offs.ctypes.data_as(i64p), szs.ctypes.data_as(i64p), n,
+            shard_size, None, None, None, None, None)
+        leaf = np.empty(count, np.int32)
+        shard = np.empty(count, np.int32)
+        lb = np.empty(count, np.int64)
+        le = np.empty(count, np.int64)
+        sb = np.empty(count, np.int64)
+        lib.plan_fragments(
+            offs.ctypes.data_as(i64p), szs.ctypes.data_as(i64p), n,
+            shard_size,
+            leaf.ctypes.data_as(i32p), shard.ctypes.data_as(i32p),
+            lb.ctypes.data_as(i64p), le.ctypes.data_as(i64p),
+            sb.ctypes.data_as(i64p))
+        return {"leaf": leaf, "shard": shard, "leaf_begin": lb,
+                "leaf_end": le, "shard_begin": sb}
+    leaf, shard, lb, le, sb = [], [], [], [], []
+    for i in range(n):
+        beg, end = int(offs[i]), int(offs[i] + szs[i])
+        s = beg // shard_size
+        while s * shard_size < end:
+            s0, s1 = s * shard_size, (s + 1) * shard_size
+            ob, oe = max(beg, s0), min(end, s1)
+            if oe > ob:
+                leaf.append(i)
+                shard.append(s)
+                lb.append(ob - beg)
+                le.append(oe - beg)
+                sb.append(ob - s0)
+            s += 1
+    return {"leaf": np.asarray(leaf, np.int32),
+            "shard": np.asarray(shard, np.int32),
+            "leaf_begin": np.asarray(lb, np.int64),
+            "leaf_end": np.asarray(le, np.int64),
+            "shard_begin": np.asarray(sb, np.int64)}

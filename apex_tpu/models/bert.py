@@ -1,0 +1,128 @@
+"""BERT family — benchmark config 4 (BASELINE.md: BERT-large pretrain +
+FusedLAMB + FusedRMSNorm + contrib.xentropy on a v5e-16 mesh).
+
+Encoder built from the framework's fused components: Pallas flash attention
+(bidirectional), FusedRMSNorm (config 4 pairs BERT with the RMSNorm kernel),
+dense_gelu_dense MLP, fused xentropy MLM loss. bf16 compute, fp32 params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+from apex_tpu.normalization.fused_layer_norm import FusedRMSNorm
+from apex_tpu.ops.pallas.flash_attention import flash_attention
+from apex_tpu.transformer.fused_dense import dense_gelu_dense
+from apex_tpu.transformer.mha import mha_reference
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    max_position_embeddings: int = 512
+    hidden_size: int = 1024
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    intermediate_size: int = 4096
+    type_vocab_size: int = 2
+    compute_dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=1024, max_position_embeddings=128,
+                   hidden_size=128, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=512)
+
+    @classmethod
+    def large(cls):
+        return cls()
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attn_mask: Optional[jax.Array] = None):
+        c = self.cfg
+        e = c.hidden_size
+        h = c.num_attention_heads
+        d = e // h
+        b, s, _ = x.shape
+
+        qkv = nn.Dense(3 * e, dtype=c.compute_dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if attn_mask is None and s % 128 == 0:
+            o = flash_attention(q, k, v, False)
+        else:
+            mask = None
+            if attn_mask is not None:
+                # attn_mask: (b, s) 1=valid → reference uint8 mask (1=masked)
+                mask = (1 - attn_mask)[:, None, None, :].astype(jnp.uint8)
+            o = mha_reference(q, k, v, False, mask)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        x = FusedRMSNorm(e, name="attn_norm")(
+            x + nn.Dense(e, dtype=c.compute_dtype, name="attn_out")(o))
+
+        w1 = self.param("mlp_fc_w", nn.initializers.normal(0.02),
+                        (c.intermediate_size, e), jnp.float32)
+        b1 = self.param("mlp_fc_b", nn.initializers.zeros,
+                        (c.intermediate_size,), jnp.float32)
+        w2 = self.param("mlp_proj_w", nn.initializers.normal(0.02),
+                        (e, c.intermediate_size), jnp.float32)
+        b2 = self.param("mlp_proj_b", nn.initializers.zeros, (e,),
+                        jnp.float32)
+        mlp = dense_gelu_dense(x, w1.astype(c.compute_dtype),
+                               b1.astype(c.compute_dtype),
+                               w2.astype(c.compute_dtype),
+                               b2.astype(c.compute_dtype))
+        return FusedRMSNorm(e, name="mlp_norm")(x + mlp)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attn_mask=None):
+        c = self.cfg
+        b, s = input_ids.shape
+        wte = self.param("word_embeddings", nn.initializers.normal(0.02),
+                         (c.vocab_size, c.hidden_size), jnp.float32)
+        wpe = self.param("position_embeddings", nn.initializers.normal(0.02),
+                         (c.max_position_embeddings, c.hidden_size),
+                         jnp.float32)
+        tte = self.param("token_type_embeddings",
+                         nn.initializers.normal(0.02),
+                         (c.type_vocab_size, c.hidden_size), jnp.float32)
+        x = wte[input_ids] + wpe[:s][None]
+        if token_type_ids is not None:
+            x = x + tte[token_type_ids]
+        x = FusedRMSNorm(c.hidden_size, name="emb_norm")(
+            x.astype(c.compute_dtype))
+        for i in range(c.num_hidden_layers):
+            x = BertLayer(c, name=f"layer_{i}")(x, attn_mask)
+        logits = jax.lax.dot_general(
+            x, wte.astype(c.compute_dtype), (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return logits
+
+
+def mlm_loss(model: Bert, params, input_ids, labels, ignore_index=-1):
+    """Masked-LM pretrain loss via the fused xentropy: ``padding_idx``
+    zeroes ignored positions inside the fused op; the mean is over the
+    non-ignored count."""
+    logits = model.apply(params, input_ids)
+    loss = softmax_cross_entropy_loss(logits, labels,
+                                      padding_idx=ignore_index)
+    n = jnp.maximum(jnp.sum(labels != ignore_index), 1)
+    return jnp.sum(loss) / n

@@ -30,23 +30,20 @@ def _bucket_leaves(leaves, message_size: int):
     """Greedy assignment of leaves into buckets of ≥ message_size elements,
     segregated by dtype (reference DDP buckets per dtype so fp32 grads are
     never degraded through a lower-precision flat buffer), preserving order
-    within each dtype (buckets fill as backward produces grads)."""
-    by_dtype: dict = {}
-    for i, leaf in enumerate(leaves):
-        by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
-    buckets = []
-    for idxs in by_dtype.values():
-        cur, cur_n = [], 0
-        for i in idxs:
-            cur.append(i)
-            n = int(np.prod(leaves[i].shape)) if leaves[i].shape else 1
-            cur_n += n
-            if cur_n >= message_size:
-                buckets.append(cur)
-                cur, cur_n = [], 0
-        if cur:
-            buckets.append(cur)
-    return buckets
+    within each dtype (buckets fill as backward produces grads). Plans via
+    the native helper (apex_tpu/_csrc plan_buckets) when compiled."""
+    from apex_tpu._native.api import plan_buckets as _plan_buckets
+
+    sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+    dtype_ids, dmap = [], {}
+    for leaf in leaves:
+        dt = jnp.dtype(leaf.dtype)
+        dtype_ids.append(dmap.setdefault(dt, len(dmap)))
+    bucket_ids, n_buckets = _plan_buckets(sizes, dtype_ids, message_size)
+    buckets = [[] for _ in range(n_buckets)]
+    for i, b in enumerate(bucket_ids):
+        buckets[int(b)].append(i)
+    return [b for b in buckets if b]
 
 
 def bucketed_allreduce(grads: Any, axis_name: str = "data",

@@ -43,7 +43,7 @@ from apex_tpu.ops.pallas.flash_attention import (flash_attention_bwd,
                                                  flash_attention_fwd)
 
 _f32 = jnp.float32
-_NEG = jnp.float32(-1e30)
+_NEG = -1e30  # python scalar: no device-array creation at import time
 
 
 def _merge(o1, lse1, o2, lse2):

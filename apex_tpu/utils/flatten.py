@@ -48,24 +48,23 @@ class FlatSpec:
 
 
 def flat_spec(tensors: Sequence[jax.Array] | Any, align: int = LANE) -> FlatSpec:
-    """Compute the packing plan. Accepts a list or arbitrary pytree."""
+    """Compute the packing plan. Accepts a list or arbitrary pytree.
+
+    Planning runs through the native helper (apex_tpu/_csrc) when compiled —
+    the host-side C++ twin of the reference's ParameterFragment/bucket math —
+    with a bit-identical Python fallback.
+    """
+    from apex_tpu._native.api import plan_flat as _plan_flat
+
     leaves, treedef = jax.tree_util.tree_flatten(tensors)
-    shapes, dtypes, offsets, padded = [], [], [], []
-    off = 0
-    for leaf in leaves:
-        n = int(np.prod(leaf.shape)) if leaf.shape else 1
-        p = _round_up(max(n, 1), align)
-        shapes.append(tuple(leaf.shape))
-        dtypes.append(leaf.dtype)
-        offsets.append(off)
-        padded.append(p)
-        off += p
+    sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+    offsets, padded, total = _plan_flat(sizes, align)
     return FlatSpec(
-        shapes=tuple(shapes),
-        dtypes=tuple(dtypes),
-        offsets=tuple(offsets),
-        padded_sizes=tuple(padded),
-        total_size=off,
+        shapes=tuple(tuple(l.shape) for l in leaves),
+        dtypes=tuple(l.dtype for l in leaves),
+        offsets=tuple(int(o) for o in offsets),
+        padded_sizes=tuple(int(p) for p in padded),
+        total_size=int(total),
         treedef=treedef,
     )
 

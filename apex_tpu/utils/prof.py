@@ -1,0 +1,69 @@
+"""Tracing / profiling — the framework's observability layer.
+
+Reference status (SURVEY §5): apex has no first-class tracing subsystem —
+ad-hoc NVTX ranges (``torch.cuda.nvtx``) and ``cudaProfilerStart`` in tests,
+``--prof`` iteration caps in examples. The TPU framework makes it first-class:
+
+- ``profile(logdir)``: context manager over ``jax.profiler`` producing a
+  TensorBoard-loadable device trace (the nsys/nvtx equivalent).
+- ``annotate(name)`` / ``annotate_function``: named trace ranges (the NVTX
+  ``range_push/pop`` analog) that show up in the trace viewer.
+- ``StepTimer``: the examples' AverageMeter, with proper device sync.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def profile(logdir: str = "/tmp/apex_tpu_trace"):
+    """Capture a device trace for the enclosed region (≈ nsys profile)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named range inside a trace (≈ nvtx.range_push/pop)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_function(fn, name: Optional[str] = None):
+    """Decorator form (≈ nvtx.annotate)."""
+    return jax.profiler.annotate_function(fn, name=name)
+
+
+class StepTimer:
+    """Average/last step timing with device synchronization (the examples'
+    AverageMeter; ``block`` forces completion like cudaDeviceSynchronize)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.last = 0.0
+        self._t0 = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self, block_on=None):
+        if block_on is not None:
+            jax.block_until_ready(block_on)
+        self.last = time.perf_counter() - self._t0
+        self.total += self.last
+        self.count += 1
+        return self.last
+
+    @property
+    def avg(self) -> float:
+        return self.total / max(self.count, 1)

@@ -1,0 +1,66 @@
+"""BASELINE config 1: 2-layer MLP with amp O1 semantics (CPU-runnable).
+
+The TPU port of examples/simple + the legacy ``amp.initialize`` flow
+(tests/L1/common/main_amp.py shape): policy cast, dynamic loss scaling,
+FusedAdam, one jitted train loop.
+
+Run: PYTHONPATH=. python examples/simple/main_amp.py [--opt-level O1]
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer import MLP
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--opt-level", default="O1",
+                    choices=["O0", "O1", "O2", "O3"])
+    ap.add_argument("--loss-scale", default="dynamic")
+    ap.add_argument("--steps", type=int, default=50)
+    args = ap.parse_args()
+
+    model = MLP([16, 64, 64, 1])
+    x = jax.random.normal(jax.random.PRNGKey(0), (512, 16))
+    y = jnp.sum(x[:, :4], axis=1, keepdims=True)
+    variables = model.init(jax.random.PRNGKey(1), x)
+
+    loss_scale = (None if args.opt_level in ("O0",)
+                  else args.loss_scale)
+    params, _, policy, scaler = amp.initialize(
+        variables["params"], None, args.opt_level, loss_scale=loss_scale)
+    opt = FusedAdam(params, lr=1e-2,
+                    master_weights=policy.master_weights)
+    sstate = scaler.init() if scaler else None
+
+    def loss_fn(p, scale_state):
+        xb = policy.cast_inputs(x)
+        pred = model.apply({"params": p}, xb).astype(jnp.float32)
+        loss = jnp.mean((pred - y) ** 2)
+        return scaler.scale(loss, scale_state) if scaler else loss
+
+    p = opt.parameters
+    for step in range(args.steps):
+        sl, grads = jax.value_and_grad(
+            lambda pp: loss_fn(pp, sstate))(p)
+        if scaler:
+            used_scale = float(sstate.scale)
+            grads, found_inf = scaler.unscale(grads, sstate)
+            p = opt.step(grads, found_inf=found_inf)
+            sstate = scaler.update(sstate, found_inf)
+            loss = float(sl) / used_scale
+        else:
+            p = opt.step(grads)
+            loss = float(sl)
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step:3d}  loss {loss:.5f}"
+                  + (f"  scale {float(sstate.scale):.0f}" if scaler else ""))
+
+
+if __name__ == "__main__":
+    main()
