@@ -13,23 +13,20 @@ tables (:247-325) are compiler concerns on TPU and intentionally absent.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.pallas import group_norm_kernel as _gnk
+
 _f32 = jnp.float32
 
 
-def group_norm_nhwc(x: jax.Array, num_groups: int,
-                    weight: Optional[jax.Array] = None,
-                    bias: Optional[jax.Array] = None, eps: float = 1e-5,
-                    act: str = "") -> jax.Array:
-    """x: (N, H, W, C); ``act`` in {"", "silu"} (the fused SiLU epilogue of
-    group_norm_nhwc_one_pass_*.cu)."""
+def _gn_jnp(x, num_groups, weight, bias, eps, act):
     n, h, w, c = x.shape
-    assert c % num_groups == 0
     x32 = x.astype(_f32).reshape(n, h * w, num_groups, c // num_groups)
     mean = jnp.mean(x32, axis=(1, 3), keepdims=True)
     var = jnp.mean(jnp.square(x32 - mean), axis=(1, 3), keepdims=True)
@@ -41,9 +38,79 @@ def group_norm_nhwc(x: jax.Array, num_groups: int,
         y = y + bias.astype(_f32)
     if act == "silu":
         y = y * jax.nn.sigmoid(y)
-    elif act:
-        raise ValueError(f"unsupported act {act!r}")
     return y.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 4, 5))
+def _gn_pallas(x, num_groups, weight, bias, eps, act):
+    y, _, _ = _gnk.group_norm_nhwc_pallas(x, num_groups, weight, bias, eps,
+                                          act)
+    return y
+
+
+def _gn_pallas_fwd(x, num_groups, weight, bias, eps, act):
+    y, mean, rstd = _gnk.group_norm_nhwc_pallas(x, num_groups, weight, bias,
+                                                eps, act)
+    return y, (x, weight, bias, mean, rstd)
+
+
+def _gn_pallas_bwd(num_groups, eps, act, res, dy):
+    """Analytic GN backward from saved (mean, rstd) — one fused XLA chain
+    (the reference ships dedicated bwd kernels; the dgamma/dbeta column
+    reductions are XLA's bread and butter)."""
+    x, weight, bias, mean, rstd = res
+    n, h, w, c = x.shape
+    g = num_groups
+    cpg = c // g
+    x32 = x.astype(_f32)
+    mean_c = jnp.repeat(mean, cpg, axis=1)[:, None, None, :]
+    rstd_c = jnp.repeat(rstd, cpg, axis=1)[:, None, None, :]
+    xhat = (x32 - mean_c) * rstd_c
+    dy32 = dy.astype(_f32)
+    if act == "silu":
+        # recompute pre-activation z and fold silu'(z) into dy
+        z = xhat
+        if weight is not None:
+            z = z * weight.astype(_f32)
+        if bias is not None:
+            z = z + bias.astype(_f32)
+        sig = jax.nn.sigmoid(z)
+        dy32 = dy32 * (sig * (1.0 + z * (1.0 - sig)))
+    dgamma = dbeta = None
+    if weight is not None:
+        dgamma = jnp.sum(dy32 * xhat, axis=(0, 1, 2)).astype(weight.dtype)
+        wdy = dy32 * weight.astype(_f32)
+    else:
+        wdy = dy32
+    if bias is not None:
+        dbeta = jnp.sum(dy32, axis=(0, 1, 2)).astype(bias.dtype)
+    # per-(n, g) means of wdy and wdy*xhat
+    wdy_g = wdy.reshape(n, h * w, g, cpg)
+    xhat_g = xhat.reshape(n, h * w, g, cpg)
+    m1 = jnp.mean(wdy_g, axis=(1, 3), keepdims=True)
+    m2 = jnp.mean(wdy_g * xhat_g, axis=(1, 3), keepdims=True)
+    dx = (wdy_g - m1 - xhat_g * m2) * rstd[:, None, :, None]
+    dx = dx.reshape(n, h, w, c).astype(x.dtype)
+    return dx, dgamma, dbeta
+
+
+_gn_pallas.defvjp(_gn_pallas_fwd, _gn_pallas_bwd)
+
+
+def group_norm_nhwc(x: jax.Array, num_groups: int,
+                    weight: Optional[jax.Array] = None,
+                    bias: Optional[jax.Array] = None, eps: float = 1e-5,
+                    act: str = "") -> jax.Array:
+    """x: (N, H, W, C); ``act`` in {"", "silu"} (the fused SiLU epilogue of
+    group_norm_nhwc_one_pass_*.cu). Dispatches to the Pallas two-pass kernel
+    pair when shapes are tile-friendly, else the jnp path."""
+    n, h, w, c = x.shape
+    assert c % num_groups == 0
+    if act not in ("", "silu"):
+        raise ValueError(f"unsupported act {act!r}")
+    if _gnk.pallas_ok(n, h * w, c):
+        return _gn_pallas(x, num_groups, weight, bias, eps, act)
+    return _gn_jnp(x, num_groups, weight, bias, eps, act)
 
 
 def torch_group_norm(x, num_groups, weight=None, bias=None, eps=1e-5,

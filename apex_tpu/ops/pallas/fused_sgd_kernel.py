@@ -1,0 +1,103 @@
+"""Pallas TPU kernel for the fused SGD update over flat parameter buffers —
+kernel-layer equivalent of ``csrc/multi_tensor_sgd_kernel.cu`` (``SGDFunctor``
+with momentum / dampening / nesterov / wd-before-or-after-momentum, depths
+2-4 incl. the fp16 model-weight copy-out).
+
+Same flat-buffer layout and capturable-scalar conventions as
+fused_adam_kernel.py (one kernel over the whole 128-lane-padded param group).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.pallas.fused_adam_kernel import (LANE, SUBLANE, _as_rows,
+                                                   _pick_block_rows)
+from apex_tpu.utils.env import interpret_default
+
+_f32 = jnp.float32
+# scalars: [lr, momentum, dampening, wd, inv_scale, noop, first_step]
+_NS = 7
+
+
+def _sgd_kernel(scal_ref, p_ref, g_ref, b_ref, p_out, b_out, *,
+                nesterov: bool, wd_after_momentum: bool):
+    lr = scal_ref[0, 0]
+    momentum = scal_ref[0, 1]
+    dampening = scal_ref[0, 2]
+    wd = scal_ref[0, 3]
+    inv_scale = scal_ref[0, 4]
+    noop = scal_ref[0, 5]
+    first = scal_ref[0, 6]
+
+    p = p_ref[...].astype(_f32)
+    g = g_ref[...].astype(_f32) * inv_scale
+    buf = b_ref[...].astype(_f32)
+
+    if not wd_after_momentum:
+        g = g + wd * p
+    b_new = jnp.where(first != 0.0, g,
+                      momentum * buf + (1.0 - dampening) * g)
+    use_momentum = momentum != 0.0
+    if nesterov:
+        d = jnp.where(use_momentum, g + momentum * b_new, g)
+    else:
+        d = jnp.where(use_momentum, b_new, g)
+    if wd_after_momentum:
+        d = d + wd * p
+    p_new = p - lr * d
+
+    keep = noop != 0.0
+    p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
+    b_out[...] = jnp.where(keep, buf,
+                           jnp.where(use_momentum, b_new, buf)
+                           ).astype(b_out.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("nesterov", "wd_after_momentum",
+                                             "block_rows", "interpret"),
+                   donate_argnums=(0, 2))
+def fused_sgd_flat(p: jax.Array, g: jax.Array, momentum_buf: jax.Array,
+                   lr, momentum: float = 0.0, dampening: float = 0.0,
+                   weight_decay=0.0, nesterov: bool = False,
+                   wd_after_momentum: bool = False, inv_scale=1.0,
+                   found_inf=False, first_step=False,
+                   block_rows: int | None = None,
+                   interpret: bool | None = None):
+    """One fused SGD step over flat 1-D buffers. Returns ``(p, momentum_buf)``.
+    ``p``/``momentum_buf`` donated; scalars may be traced (capturable)."""
+    if interpret is None:
+        interpret = interpret_default()
+    scal = jnp.stack([
+        jnp.asarray(lr, _f32), _f32(momentum), _f32(dampening),
+        jnp.asarray(weight_decay, _f32), jnp.asarray(inv_scale, _f32),
+        jnp.asarray(found_inf, _f32), jnp.asarray(first_step, _f32),
+    ]).reshape(1, _NS)
+    p2, g2, b2 = _as_rows(p), _as_rows(g), _as_rows(momentum_buf)
+    rows = p2.shape[0]
+    br = block_rows or _pick_block_rows(rows)
+    grid = (rows // br,)
+
+    def dspec():
+        return pl.BlockSpec((br, LANE), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+
+    p_new, b_new = pl.pallas_call(
+        functools.partial(_sgd_kernel, nesterov=nesterov,
+                          wd_after_momentum=wd_after_momentum),
+        grid=grid,
+        in_specs=[pl.BlockSpec((1, _NS), lambda i: (0, 0),
+                               memory_space=pltpu.SMEM),
+                  dspec(), dspec(), dspec()],
+        out_specs=[dspec(), dspec()],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(b2.shape, b2.dtype)],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret,
+    )(scal, p2, g2, b2)
+    return p_new.reshape(p.shape), b_new.reshape(momentum_buf.shape)

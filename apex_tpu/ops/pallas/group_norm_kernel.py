@@ -1,0 +1,153 @@
+"""Pallas TPU kernels for NHWC GroupNorm (+ fused SiLU) — the kernel-layer
+equivalent of ``group_norm_cuda`` / ``group_norm_v2_cuda``
+(apex/contrib/csrc/group_norm*: one-pass & two-pass NHWC algorithms across 27
+per-channel-count instantiations; SURVEY §2.3).
+
+TPU design: the two-pass structure survives (pass 1: per-(sample, group)
+sum/sumsq partials accumulated across HW tiles; pass 2: normalize + affine +
+SiLU fused over the same tiles) but ONE kernel pair covers every channel
+count — per-shape instantiation is the Mosaic compiler's job. Stats fp32.
+The backward uses the saved (mean, rstd) in one fused XLA chain (the
+dgamma/dbeta reductions are column sums XLA already tiles well).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils.env import interpret_default
+
+_f32 = jnp.float32
+
+
+def pallas_ok(n: int, hw: int, c: int) -> bool:
+    """Shape guard: HW tiles need 8-sublane alignment."""
+    return hw % 8 == 0
+
+
+def _pick_hw_block(hw: int, c: int) -> int:
+    budget = max((2 * 1024 * 1024) // max(c * 4, 1), 8)
+    blk = 1 << (budget.bit_length() - 1)
+    blk = min(blk, hw)
+    while hw % blk != 0 and blk > 8:
+        blk //= 2
+    return max(blk, 8)
+
+
+def _stats_kernel(x_ref, sel_ref, sum_ref, sq_ref):
+    """Per-group partials via an MXU matmul with the (C, G) group-selector —
+    no lane-dim reshapes (Mosaic-unfriendly)."""
+    h = pl.program_id(1)
+
+    @pl.when(h == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+
+    x = x_ref[0].astype(_f32)                     # (hwb, C)
+    sel = sel_ref[...]                            # (C, G) one-hot
+    csum = jnp.sum(x, axis=0, keepdims=True)      # (1, C)
+    csq = jnp.sum(x * x, axis=0, keepdims=True)
+    # HIGHEST: keep full fp32 operand mantissas on the MXU — these are
+    # large per-channel sums and default (bf16-operand) precision would put
+    # ~1e-3 relative error into the group statistics
+    sum_ref[...] += jnp.dot(csum, sel, preferred_element_type=_f32,
+                            precision=jax.lax.Precision.HIGHEST)[None]
+    sq_ref[...] += jnp.dot(csq, sel, preferred_element_type=_f32,
+                           precision=jax.lax.Precision.HIGHEST)[None]
+
+
+def _apply_kernel(x_ref, mean_ref, rstd_ref, w_ref, b_ref, y_ref, *,
+                  act: str):
+    x = x_ref[0].astype(_f32)                     # (hwb, C)
+    y = (x - mean_ref[0]) * rstd_ref[0]
+    if w_ref is not None:
+        y = y * w_ref[...].astype(_f32)
+    if b_ref is not None:
+        y = y + b_ref[...].astype(_f32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    y_ref[0] = y.astype(y_ref.dtype)
+
+
+def group_norm_nhwc_pallas(x: jax.Array, num_groups: int,
+                           weight: Optional[jax.Array] = None,
+                           bias: Optional[jax.Array] = None,
+                           eps: float = 1e-5, act: str = "",
+                           interpret: Optional[bool] = None):
+    """Forward: returns (y, mean, rstd) with mean/rstd (N, G) fp32."""
+    if interpret is None:
+        interpret = interpret_default()
+    n, h, w, c = x.shape
+    g = num_groups
+    hw = h * w
+    x3 = x.reshape(n, hw, c)
+    hwb = _pick_hw_block(hw, c)
+    grid = (n, hw // hwb)
+
+    xspec = pl.BlockSpec((1, hwb, c), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    gspec = pl.BlockSpec((1, 1, g), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    selspec = pl.BlockSpec((c, g), lambda i, j: (0, 0),
+                           memory_space=pltpu.VMEM)
+    cpg = c // g
+    sel = (jax.lax.broadcasted_iota(jnp.int32, (c, g), 0) // cpg
+           == jax.lax.broadcasted_iota(jnp.int32, (c, g), 1)).astype(_f32)
+
+    sums, sqs = pl.pallas_call(
+        _stats_kernel,
+        grid=grid,
+        in_specs=[xspec, selspec],
+        out_specs=[gspec, gspec],
+        out_shape=[jax.ShapeDtypeStruct((n, 1, g), _f32),
+                   jax.ShapeDtypeStruct((n, 1, g), _f32)],
+        interpret=interpret,
+    )(x3, sel)
+    cnt = _f32(hw * (c // g))
+    mean = sums[:, 0] / cnt                                    # (N, G)
+    var = sqs[:, 0] / cnt - mean * mean
+    rstd = jax.lax.rsqrt(var + eps)
+
+    mean_c = jnp.repeat(mean, cpg, axis=1).reshape(n, 1, c)
+    rstd_c = jnp.repeat(rstd, cpg, axis=1).reshape(n, 1, c)
+
+    cspec = pl.BlockSpec((1, 1, c), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    in_specs = [xspec, cspec, cspec]
+    args = [x3, mean_c, rstd_c]
+    wspec = pl.BlockSpec((1, c), lambda i, j: (0, 0),
+                         memory_space=pltpu.VMEM)
+    if weight is not None:
+        in_specs.append(wspec)
+        args.append(weight.reshape(1, c))
+    if bias is not None:
+        in_specs.append(wspec)
+        args.append(bias.reshape(1, c))
+
+    def kernel(*refs):
+        if weight is not None and bias is not None:
+            x_ref, m_ref, r_ref, w_ref, b_ref, y_ref = refs
+        elif weight is not None:
+            x_ref, m_ref, r_ref, w_ref, y_ref = refs
+            b_ref = None
+        else:
+            x_ref, m_ref, r_ref, y_ref = refs
+            w_ref = b_ref = None
+        _apply_kernel(x_ref, m_ref, r_ref, w_ref, b_ref, y_ref, act=act)
+
+    y = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=xspec,
+        out_shape=jax.ShapeDtypeStruct((n, hw, c), x.dtype),
+        interpret=interpret,
+    )(*args)
+    return y.reshape(n, h, w, c), mean, rstd

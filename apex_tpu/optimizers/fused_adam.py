@@ -117,7 +117,8 @@ class FusedAdam(FusedOptimizerBase):
 
     def state_dict(self):
         sd = super().state_dict()
-        if self.use_flat:
+        if self.use_flat and self.master_weights:
+            # the flat fp32 master is NOT derivable from low-precision params
             import numpy as np
             sd["flat_p"] = np.asarray(self._flat_p)
         return sd

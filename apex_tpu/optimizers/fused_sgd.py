@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from apex_tpu.optimizers._base import (FusedOptimizerBase, master_copy,
                                        zeros_like_f32)
 from apex_tpu.optimizers.functional import sgd_update
+from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
 
 
 class FusedSGD(FusedOptimizerBase):
@@ -21,7 +22,7 @@ class FusedSGD(FusedOptimizerBase):
                  dampening: float = 0.0, weight_decay: float = 0.0,
                  nesterov: bool = False, wd_after_momentum: bool = False,
                  materialize_master_grads: bool = True,
-                 master_weights: bool = False):
+                 master_weights: bool = False, use_flat: bool = False):
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires a momentum and zero dampening")
@@ -33,9 +34,64 @@ class FusedSGD(FusedOptimizerBase):
         self.wd_after_momentum = wd_after_momentum
         self.materialize_master_grads = materialize_master_grads
         self.master_weights = master_weights
-        self.state = {"momentum_buffer": zeros_like_f32(params)}
-        if master_weights:
-            self.state["master"] = master_copy(params)
+        self.use_flat = use_flat
+        if use_flat:
+            self._spec = flat_spec(params)
+            # master_weights: the flat buffer IS the fp32 master; params are
+            # its low-precision unflatten views
+            self._flat_p = flatten(
+                params, self._spec,
+                dtype=jnp.float32 if master_weights else None, pad_to=1024)
+            self.state = {"momentum_buffer": jnp.zeros_like(
+                self._flat_p, dtype=jnp.float32)}
+        else:
+            self.state = {"momentum_buffer": zeros_like_f32(params)}
+            if master_weights:
+                self.state["master"] = master_copy(params)
+
+    def step(self, grads: Any, lr=None, inv_scale=1.0, found_inf=False):
+        if not self.use_flat:
+            return super().step(grads, lr=lr, inv_scale=inv_scale,
+                                found_inf=found_inf)
+        from apex_tpu.ops.pallas.fused_sgd_kernel import fused_sgd_flat
+        first = self._step == 0
+        self._step = self._step + jnp.where(
+            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
+        flat_g = flatten(grads, self._spec, dtype=self._flat_p.dtype,
+                         pad_to=self._flat_p.size)
+        p, buf = fused_sgd_flat(
+            self._flat_p, flat_g, self.state["momentum_buffer"],
+            lr=jnp.asarray(self._lr if lr is None else lr, jnp.float32),
+            momentum=self.momentum, dampening=self.dampening,
+            weight_decay=self.weight_decay, nesterov=self.nesterov,
+            wd_after_momentum=self.wd_after_momentum, inv_scale=inv_scale,
+            found_inf=found_inf, first_step=first)
+        self._flat_p, self.state["momentum_buffer"] = p, buf
+        self._params = unflatten(p, self._spec)
+        return self._params
+
+    def set_parameters(self, params):
+        super().set_parameters(params)
+        if self.use_flat:
+            self._flat_p = flatten(params, self._spec,
+                                   dtype=self._flat_p.dtype, pad_to=1024)
+
+    def state_dict(self):
+        sd = super().state_dict()
+        if self.use_flat and self.master_weights:
+            # the flat fp32 master is NOT derivable from low-precision params
+            import numpy as np
+            sd["flat_p"] = np.asarray(self._flat_p)
+        return sd
+
+    def load_state_dict(self, sd):
+        super().load_state_dict(sd)
+        if self.use_flat:
+            if "flat_p" in sd:
+                self._flat_p = jnp.asarray(sd["flat_p"])
+            else:
+                self._flat_p = flatten(self._params, self._spec,
+                                       dtype=self._flat_p.dtype, pad_to=1024)
 
     def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
         out = sgd_update(

@@ -1,2 +1,4 @@
 from apex_tpu.utils.flatten import flatten, unflatten, FlatSpec, flat_spec  # noqa: F401
 from apex_tpu.utils.env import interpret_default, platform_is_tpu  # noqa: F401
+from apex_tpu.utils import checkpoint  # noqa: F401
+from apex_tpu.utils import prof  # noqa: F401

@@ -1,0 +1,76 @@
+"""Checkpoint / resume — the framework's persistence layer (SURVEY §5).
+
+Reference surface: optimizer ``state_dict``/``load_state_dict`` everywhere;
+the non-trivial piece is DistributedFusedAdam's v1 gather-on-root
+(distributed_fused_adam.py:2907) vs v2 sharded save with per-bucket gather on
+load (:3059-3329). SURVEY maps v2 to "orbax-style sharded checkpoint".
+
+This module provides both flavors over any pytree (train state, flax
+variables, optimizer.state_dict()):
+- ``save`` / ``restore``: orbax-backed sharded checkpointing — each device
+  writes its own shards, restore re-shards to the current mesh layout
+  (the v2 semantics, generalized).
+- ``save_numpy`` / ``restore_numpy``: single-file .npz gather-on-host
+  (v1 semantics; also the fallback when orbax is unavailable).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def save(path: str, tree: Any) -> None:
+    """Sharded (v2-style) checkpoint via orbax."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def restore(path: str, like: Optional[Any] = None) -> Any:
+    """Restore an orbax checkpoint; ``like`` (a pytree of arrays or
+    ShapeDtypeStructs, optionally carrying shardings) re-shards onto the
+    current mesh — the v2 'all-gather on load into the new layout'."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        target = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=getattr(x, "sharding",
+                                                            None)), like)
+        return ckptr.restore(path, target)
+    return ckptr.restore(path)
+
+
+def save_numpy(path: str, tree: Any) -> None:
+    """Gather-on-host single-file save (v1 semantics)."""
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    # structure is reconstructed from `like` on restore (a PyTreeDef is not
+    # serializable); only the leaves are stored
+    np.savez(path,
+             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+
+
+def restore_numpy(path: str, like: Any) -> Any:
+    """Restore a save_numpy checkpoint into the structure of ``like``.
+
+    numpy stores extension dtypes (bfloat16, fp8) as raw void bytes; they are
+    viewed back through the dtype recorded in ``like``.
+    """
+    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    leaves, treedef = jax.tree_util.tree_flatten(like)
+    out = []
+    for i, ref in enumerate(leaves):
+        arr = data[f"leaf_{i}"]
+        if arr.dtype.kind == "V" and hasattr(ref, "dtype"):
+            arr = arr.view(ref.dtype)
+        out.append(jax.numpy.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -373,3 +373,37 @@ class TestSpatialBottleneck:
                                mutable=["batch_stats"])
         np.testing.assert_allclose(np.asarray(y_sp), np.asarray(y_full),
                                    atol=1e-4, rtol=1e-4)
+
+
+class TestGroupNormPallas:
+    def test_pallas_path_matches_jnp(self):
+        from apex_tpu.contrib.group_norm import _gn_jnp, group_norm_nhwc
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4, 32))  # HW=16
+        w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (32,))
+        b = 0.1 * jax.random.normal(jax.random.PRNGKey(2), (32,))
+        for act in ("", "silu"):
+            y = group_norm_nhwc(x, 8, w, b, act=act)  # pallas (16 % 8 == 0)
+            ref = _gn_jnp(x, 8, w, b, 1e-5, act)
+            np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_pallas_grads_match_jnp(self):
+        from apex_tpu.contrib.group_norm import _gn_jnp, group_norm_nhwc
+        x = jax.random.normal(jax.random.PRNGKey(3), (2, 4, 4, 16))
+        w = 1 + 0.1 * jax.random.normal(jax.random.PRNGKey(4), (16,))
+        b = 0.1 * jax.random.normal(jax.random.PRNGKey(5), (16,))
+        for act in ("", "silu"):
+            gp = jax.grad(lambda x, w, b: jnp.sum(
+                group_norm_nhwc(x, 4, w, b, act=act) ** 2), (0, 1, 2))(
+                    x, w, b)
+            gr = jax.grad(lambda x, w, b: jnp.sum(
+                _gn_jnp(x, 4, w, b, 1e-5, act) ** 2), (0, 1, 2))(x, w, b)
+            for a, r in zip(gp, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                           atol=1e-4, rtol=1e-4)
+
+    def test_odd_hw_falls_back(self):
+        from apex_tpu.contrib.group_norm import group_norm_nhwc
+        x = jax.random.normal(jax.random.PRNGKey(6), (1, 3, 3, 8))  # HW=9
+        y = group_norm_nhwc(x, 2)
+        assert bool(jnp.all(jnp.isfinite(y)))

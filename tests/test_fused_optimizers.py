@@ -275,3 +275,51 @@ class TestStateDict:
         opt2.step(g)
         for a, b in zip(opt.parameters, opt2.parameters):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFusedSGDFlat:
+    @pytest.mark.parametrize("momentum,nesterov,wd",
+                             [(0.0, False, 0.0), (0.9, False, 1e-4),
+                              (0.9, True, 0.0)])
+    def test_flat_pallas_matches_tree(self, momentum, nesterov, wd):
+        params = _make_params()
+        o1 = FusedSGD(params, lr=0.1, momentum=momentum, nesterov=nesterov,
+                      weight_decay=wd)
+        o2 = FusedSGD(params, lr=0.1, momentum=momentum, nesterov=nesterov,
+                      weight_decay=wd, use_flat=True)
+        for step in range(1, 4):
+            g = _make_grads(step)
+            o1.step(g)
+            o2.step(g)
+        for a, b in zip(o1.parameters, o2.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_flat_found_inf_noop(self):
+        params = _make_params()
+        opt = FusedSGD(params, lr=0.1, momentum=0.9, use_flat=True)
+        before = [np.asarray(p) for p in params]
+        opt.step(_make_grads(1), found_inf=True)
+        for b, a in zip(before, opt.parameters):
+            np.testing.assert_array_equal(b, np.asarray(a))
+        # first real step still initializes the momentum buffer correctly
+        opt.step(_make_grads(1))
+        ref = FusedSGD(params, lr=0.1, momentum=0.9)
+        ref.step(_make_grads(1))
+        for a, b in zip(opt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+
+class TestFusedSGDFlatMaster:
+    def test_flat_master_weights_accumulate_fp32(self):
+        """bf16 params + use_flat + master_weights: tiny updates below bf16
+        resolution must still accumulate (in the fp32 flat master)."""
+        p16 = [jnp.ones((128,), jnp.bfloat16)]
+        opt = FusedSGD(p16, lr=1e-4, master_weights=True, use_flat=True)
+        assert opt._flat_p.dtype == jnp.float32
+        for _ in range(4):
+            opt.step([jnp.full((128,), 0.5, jnp.bfloat16)])
+        master = np.asarray(opt._flat_p[:128])
+        np.testing.assert_allclose(master, 1.0 - 4 * 1e-4 * 0.5, rtol=1e-5)
+        assert opt.parameters[0].dtype == jnp.bfloat16
