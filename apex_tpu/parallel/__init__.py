@@ -24,3 +24,9 @@ from apex_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
 )
+from apex_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    stack_stage_params,
+    unstack_local,
+)
+from apex_tpu.parallel.moe import moe_ffn_ep, top1_dispatch  # noqa: F401

@@ -1,0 +1,96 @@
+"""Pipeline parallelism (GPipe) over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.5: PP absent from apex);
+the TPU framework provides it as a first-class axis alongside dp/tp/sp.
+
+Design: the homogeneous stage stack is sharded over the ``pp`` axis (each
+device holds one stage's params, passed as stacked leaves with a leading
+stage dim). The GPipe schedule is a ``lax.scan`` over M + P - 1 ticks: stage
+0 ingests a fresh microbatch each tick, every stage applies its layer to
+whatever sits in its input buffer, and activations hop to the next stage with
+``ppermute`` (one ICI neighbor transfer per tick). The backward pass needs no
+hand-written schedule: autodiff transposes the scan and the ppermute, yielding
+the reverse pipeline automatically.
+
+Bubble fraction = (P-1)/(M+P-1), the standard GPipe tradeoff — pick
+num_microbatches ≥ 4·P. Interleaved (1F1B) scheduling is a planned refinement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
+                   axis_name: str = "pp",
+                   num_microbatches: int = 4) -> jax.Array:
+    """Run a P-stage pipeline over the ``axis_name`` mesh axis.
+
+    Call INSIDE shard_map. ``stage_params``: this device's stage params (pass
+    stacked params with in_specs=P('pp', ...) and squeeze the leading dim, or
+    any per-device tree). ``stage_fn(params, x_micro) -> y_micro`` must
+    preserve the microbatch shape (homogeneous stages). ``x``: the full local
+    batch (B, ...), B divisible by num_microbatches; every device receives
+    the same x (replicated in-specs) and stage 0 feeds it in.
+
+    Returns the pipeline output (B, ...) — valid on every device (the last
+    stage's results are broadcast back over the axis).
+    """
+    p = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    m = num_microbatches
+    b = x.shape[0]
+    assert b % m == 0, "num_microbatches must divide the batch size"
+    mb = b // m
+    micro = x.reshape(m, mb, *x.shape[1:])
+    ticks = m + p - 1
+
+    fwd_perm = [(i, i + 1) for i in range(p - 1)]
+
+    def tick(buf, t):
+        # stage 0 ingests microbatch t (clamped; garbage ticks are discarded)
+        idx = jnp.clip(t, 0, m - 1)
+        fresh = jax.lax.dynamic_index_in_dim(micro, idx, 0, keepdims=False)
+        inp = jnp.where(my == 0, fresh, buf)
+        out = stage_fn(stage_params, inp)
+        nxt = jax.lax.ppermute(out, axis_name, fwd_perm)
+        return nxt, out
+
+    # initial carry = a real microbatch, NOT zeros: bubble ticks run stage_fn
+    # on this buffer and discard the result, but a zeros input could produce
+    # NaN primals (e.g. eps-free norms) that poison the scan VJP via
+    # 0-cotangent × NaN. stage_fn must be finite on finite inputs.
+    _, outs = jax.lax.scan(tick, micro[0], jnp.arange(ticks))
+    # last stage's valid outputs are at ticks [p-1, p-1+m)
+    valid = jax.lax.dynamic_slice_in_dim(outs, p - 1, m, axis=0)
+    y = valid.reshape(b, *x.shape[1:])
+    # broadcast the last stage's result to every device: zero elsewhere + psum
+    y = jnp.where(my == p - 1, y, jnp.zeros_like(y))
+    return jax.lax.psum(y, axis_name)
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of per-stage param trees along a new leading axis, for
+    sharding with in_specs=P('pp', ...)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def unstack_local(params: Any) -> Any:
+    """Inside shard_map: squeeze the leading (local stage) dim of 1.
+
+    Raises if more than one stage landed on this device (stage count must
+    equal the pp axis size — silently using stage 0 of several would compute
+    a wrong, shorter pipeline).
+    """
+
+    def squeeze(l):
+        assert l.shape[0] == 1, (
+            f"{l.shape[0]} stages per device: stack exactly axis_size stages "
+            f"(stage count must equal the pp mesh axis size)")
+        return l[0]
+
+    return jax.tree_util.tree_map(squeeze, params)
