@@ -75,7 +75,10 @@ class DistributedFusedAdam:
                  bias_correction: bool = True, betas=(0.9, 0.999),
                  eps: float = 1e-8, adam_w_mode: bool = True,
                  weight_decay: float = 0.0, axis: str = "data",
-                 redundant_axis: Optional[str] = None,
+                 redundant_axis: Optional[str] = None,  # 2D grid: pass a 2D
+                 # mesh (axis, redundant_axis); P(axis) shardings replicate
+                 # the state across the redundant axis automatically — the
+                 # reference's shard × replica process grid (:316-328)
                  state_dtype=jnp.float32, grad_sync_dtype=None,
                  store_param_remainders: bool = False,
                  overlap_grad_sync: bool = True,
@@ -97,6 +100,12 @@ class DistributedFusedAdam:
         self.grad_sync_dtype = grad_sync_dtype
         self.store_param_remainders = store_param_remainders
 
+        if redundant_axis is not None and \
+                redundant_axis not in mesh.axis_names:
+            raise ValueError(
+                f"redundant_axis {redundant_axis!r} is not a mesh axis "
+                f"{mesh.axis_names}; pass a 2D mesh (axis, redundant_axis) "
+                "to get state replication over the redundant group")
         world = mesh.shape[axis]
         self._spec = flat_spec(params)
         pad = 1024 * world
@@ -248,10 +257,17 @@ class DistributedFusedAdam:
         entry maps shard index → host array; pair with ``flat_spec`` metadata
         for reload on a different world size."""
         world = self.mesh.shape[self.axis]
+        shard_size = self._n // world
 
         def shards(x):
-            return {i: np.asarray(s.data)
-                    for i, s in enumerate(x.addressable_shards)}
+            # key by shard POSITION and dedup: on a 2D (shard × replica)
+            # grid each shard index appears once per replica
+            out = {}
+            for s in x.addressable_shards:
+                idx = (s.index[0].start or 0) // shard_size
+                if idx not in out:
+                    out[idx] = np.asarray(s.data)
+            return out
 
         master = (_join_f32(self._master_hi, self._master_lo)
                   if self.store_param_remainders else self._master)

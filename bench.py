@@ -22,15 +22,58 @@ on the real chip.
 from __future__ import annotations
 
 import json
+import os
+
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+
+def _backend_with_timeout(seconds: int = 180):
+    """Initialize the JAX backend, guarding against a wedged TPU relay (the
+    axon sitecustomize initializes the TPU client on ANY backend request and
+    can hang indefinitely if a previous holder died mid-claim; the hang sits
+    in C so in-process alarms can't interrupt it). Probe in a subprocess with
+    a hard timeout; if the probe hangs, re-exec this script on pure CPU
+    (axon hook stripped) so the driver still gets a JSON line."""
+    if os.environ.get("APEX_TPU_BENCH_CPU") != "1":
+        # SIGTERM (not SIGKILL) on timeout so the probe can release its TPU
+        # claim cleanly — a hard kill mid-claim would itself wedge the relay
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            ok = proc.wait(timeout=seconds) == 0
+        except subprocess.TimeoutExpired:
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+            ok = False
+        if not ok:
+            env = dict(os.environ)
+            env["APEX_TPU_BENCH_CPU"] = "1"
+            env["JAX_PLATFORMS"] = "cpu"
+            # strip only the axon site hook; keep the caller's other entries
+            here = os.path.dirname(os.path.abspath(__file__))
+            kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                    if p and "axon" not in p]
+            env["PYTHONPATH"] = os.pathsep.join(kept + [here])
+            os.execve(sys.executable, [sys.executable, __file__], env)
+
+    import jax
+
+    return jax, jax.default_backend()
 
 
 def main():
-    on_tpu = jax.default_backend() == "tpu"
-    n = 1_000_000_000 if on_tpu else 4_194_304
+    jax, backend = _backend_with_timeout()
+    import jax.numpy as jnp
+
+    on_tpu = backend == "tpu"
+    n = 1_000_000_000 if on_tpu else 1_048_576  # CPU smoke runs interpret mode
     # round to the flat-buffer tile granularity (8*128)
     n = (n // 1024) * 1024
 
@@ -50,7 +93,7 @@ def main():
     p, m, v = step(p, g, m, v, jnp.int32(1))
     p.block_until_ready()
 
-    iters = 20 if on_tpu else 5
+    iters = 20 if on_tpu else 2
     t0 = time.perf_counter()
     for i in range(iters):
         p, m, v = step(p, g, m, v, jnp.int32(2 + i))
