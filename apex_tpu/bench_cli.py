@@ -1,0 +1,60 @@
+"""Console entry point (``apex-tpu-bench``) — runs the repo benchmark suite.
+
+Delegates to the repo-root bench.py when present (the driver's interface),
+else runs the packaged headline benchmark inline.
+"""
+
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def _inline_bench() -> None:
+    """Packaged fallback: the headline fused-Adam benchmark at wheel-install
+    scale (no repo checkout). Same metric semantics as bench.py."""
+    import json
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.pallas.fused_adam_kernel import fused_adam_flat
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = (1_000_000_000 if on_tpu else 1_048_576) // 1024 * 1024
+    p = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.bfloat16) * 0.02
+    g = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.bfloat16)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    p, m, v = fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
+                              step=jnp.int32(1), inv_scale=1.0)
+    p.block_until_ready()
+    iters = 20 if on_tpu else 2
+    t0 = time.perf_counter()
+    for i in range(iters):
+        p, m, v = fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
+                                  step=jnp.int32(2 + i), inv_scale=1.0)
+    p.block_until_ready()
+    ms = (time.perf_counter() - t0) / iters * 1e3
+    ref_ms = n * 22 / (1555e9 * 0.85) * 1e3
+    print(json.dumps({
+        "metric": f"fused_adam_step_ms_at_{n // 1_000_000}M_params"
+                  f"_bf16p_f32state",
+        "value": round(ms, 3), "unit": "ms",
+        "vs_baseline": round(ref_ms / ms, 3)}))
+
+
+def main() -> None:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench = os.path.join(here, "bench.py")
+    if os.path.exists(bench):
+        sys.argv = [bench] + sys.argv[1:]
+        runpy.run_path(bench, run_name="__main__")
+        return
+    _inline_bench()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,55 +1,227 @@
 """Channel-permutation search for 2:4 sparsity — TPU equivalent of
-``apex/contrib/sparsity/permutation_lib.py`` (2068 LoC) and the
-``permutation_search_cuda`` kernels (GPU channel-permutation search).
+``apex/contrib/sparsity/permutation_lib.py`` + the
+``permutation_search_kernels`` package (exhaustive_search.py,
+channel_swap.py, permutation_utilities.py) and the
+``permutation_search_cuda`` kernels.
 
-Goal: permute input channels so the 2:4 mask preserves more magnitude
-(accuracy). The reference runs a bounded greedy/exhaustive GPU search; here a
-vectorized greedy column-swap search in jnp — device-agnostic, bounded
-iterations, jit-friendly per sweep.
+Goal: permute a weight's input channels so the 2:4 mask preserves more
+magnitude (and thus accuracy). Both reference search strategies are
+implemented, vectorized in numpy (the search is a host-side preprocessing
+pass — the reference only uses CUDA to batch-evaluate candidate
+permutations, which numpy broadcasting does here):
+
+- **bounded-exhaustive** (ref exhaustive_search.py ``Exhaustive_Search``):
+  slide a window of ``stripe_group_size`` columns over all stripe
+  combinations; within a window, evaluate EVERY canonical permutation
+  (sorted groups of 4, groups sorted — the reference's duplicate
+  elimination, ``is_canonical``) in one batched magnitude computation; take
+  the best; repeat passes until no window improves; then bounded random
+  "escape" swaps (ref ``escape_attempts``) to leave local minima.
+- **greedy channel swaps** (ref channel_swap.py): build the full
+  improvement map over all cross-stripe column-pair swaps, apply the best
+  positive entry, recompute, until convergence (the deterministic variant
+  of the reference's progressive random search).
+
+All candidate evaluation reduces to ``sum_after_2_to_4`` (ref
+permutation_utilities.py:56): the magnitude kept by ideal 2:4 pruning =
+sum of the top-2 |w| in every row×4-column stripe.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+import itertools
+from typing import Optional, Tuple
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
-
-_f32 = jnp.float32
+_window_perm_cache: dict = {}
 
 
-def _mask_magnitude(w: jax.Array, pattern: str) -> jax.Array:
-    m = create_mask(w, pattern)
-    return jnp.sum(jnp.abs(w.astype(_f32)) * m)
+def sum_after_2_to_4(matrix: np.ndarray) -> float:
+    """Magnitude kept by 2:4 pruning (top-2 |w| per row per 4-col stripe)."""
+    a = np.abs(matrix.reshape(matrix.shape[0], -1, 4))
+    return float(np.sum(np.sort(a, axis=2)[:, :, 2:]))
+
+
+def _stripe_kept(matrix: np.ndarray) -> np.ndarray:
+    """Kept magnitude per stripe: (num_stripes,)."""
+    a = np.abs(matrix.reshape(matrix.shape[0], -1, 4))
+    return np.sort(a, axis=2)[:, :, 2:].sum(axis=(0, 2))
+
+
+def _unique_group_partitions(cols, m):
+    """All partitions of ``cols`` into sorted groups of ``m`` with groups
+    sorted by first element — the reference's canonical-form enumeration
+    (exhaustive_search.py ``is_canonical``: column order within a stripe and
+    stripe order don't change the 2:4 magnitude, so only one representative
+    per equivalence class is evaluated)."""
+    if not cols:
+        yield ()
+        return
+    first = cols[0]
+    rest = cols[1:]
+    for grp_rest in itertools.combinations(rest, m - 1):
+        grp = (first,) + grp_rest
+        taken = set(grp_rest)
+        remaining = tuple(c for c in rest if c not in taken)
+        for tail in _unique_group_partitions(remaining, m):
+            yield (grp,) + tail
+
+
+def canonical_window_permutations(c: int, m: int = 4) -> np.ndarray:
+    """(P, c) array of canonical permutations of ``c`` columns in groups of
+    ``m`` (ref ``generate_all_unique_combinations``; P = c!/((m!)^g · g!))."""
+    key = (c, m)
+    if key not in _window_perm_cache:
+        perms = [np.fromiter(itertools.chain.from_iterable(p), np.int64)
+                 for p in _unique_group_partitions(tuple(range(c)), m)]
+        _window_perm_cache[key] = np.stack(perms)
+    return _window_perm_cache[key]
+
+
+def _best_window_perm(matrix: np.ndarray, window_cols: np.ndarray
+                      ) -> Tuple[float, np.ndarray]:
+    """Batched exhaustive evaluation of one window (ref search_matrix, the
+    role of the CUDA ``try_permutations_on_matrix`` kernel)."""
+    perms = canonical_window_permutations(len(window_cols))
+    sub = matrix[:, window_cols]                       # (R, W)
+    cand = sub[:, perms]                               # (R, P, W)
+    a = np.abs(cand.reshape(cand.shape[0], perms.shape[0], -1, 4))
+    kept = np.sort(a, axis=3)[:, :, :, 2:].sum(axis=(0, 2, 3))  # (P,)
+    best = int(np.argmax(kept))
+    return float(kept[best]), perms[best]
+
+
+def exhaustive_search(matrix: np.ndarray, stripe_group_size: int = 8,
+                      escape_attempts: int = 100,
+                      seed: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """Bounded-exhaustive permutation search (ref ``Exhaustive_Search``).
+
+    Returns ``(permuted_matrix, permutation)``.
+    """
+    matrix = np.array(matrix, dtype=np.float64, copy=True)
+    r, c = matrix.shape
+    assert c % 4 == 0
+    num_stripes = c // 4
+    stripes_per_window = stripe_group_size // 4
+    perm = np.arange(c)
+    rng = np.random.default_rng(seed)
+
+    if num_stripes < stripes_per_window:
+        return matrix, perm
+
+    improved = True
+    while improved:
+        improved = False
+        for combo in itertools.combinations(range(num_stripes),
+                                            stripes_per_window):
+            window_cols = np.concatenate(
+                [np.arange(s * 4, s * 4 + 4) for s in combo])
+            base = sum_after_2_to_4(matrix[:, window_cols])
+            best_kept, best_p = _best_window_perm(matrix, window_cols)
+            if best_kept > base + 1e-9:
+                new_cols = window_cols[best_p]
+                matrix[:, window_cols] = matrix[:, new_cols]
+                perm[window_cols] = perm[new_cols]
+                improved = True
+        if not improved and escape_attempts > 0:
+            # bounded escape (ref escape_attempts): random cross-stripe
+            # swaps accepted only on improvement re-arm the window passes
+            for _ in range(escape_attempts):
+                i, j = (int(x) for x in rng.integers(0, c, 2))
+                if i // 4 == j // 4:
+                    continue
+                si, sj = i // 4, j // 4
+                two = np.concatenate([np.arange(si * 4, si * 4 + 4),
+                                      np.arange(sj * 4, sj * 4 + 4)])
+                kept0 = sum_after_2_to_4(matrix[:, two])
+                matrix[:, [i, j]] = matrix[:, [j, i]]
+                kept1 = sum_after_2_to_4(matrix[:, two])
+                if kept1 > kept0 + 1e-9:
+                    perm[[i, j]] = perm[[j, i]]
+                    improved = True
+                else:
+                    matrix[:, [i, j]] = matrix[:, [j, i]]  # revert
+            escape_attempts = 0  # one escape round per convergence
+    return matrix, perm
+
+
+def greedy_channel_swaps(matrix: np.ndarray, max_rounds: int = 100
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic greedy swap search (ref channel_swap.py): full
+    cross-stripe pair improvement map, apply best, repeat to convergence."""
+    matrix = np.array(matrix, dtype=np.float64, copy=True)
+    r, c = matrix.shape
+    assert c % 4 == 0
+    perm = np.arange(c)
+
+    for _ in range(max_rounds):
+        kept = _stripe_kept(matrix)
+        best_gain, best_pair = 0.0, None
+        for i in range(c):
+            si = i // 4
+            others = np.array([j for j in range(c) if j // 4 != si])
+            if others.size == 0:
+                continue
+            sj = others // 4
+            # stripe si with col j in place of col i, for all j: (R, J, 4)
+            stripe_i = np.repeat(matrix[:, si * 4:si * 4 + 4][:, None, :],
+                                 others.size, axis=1)
+            stripe_i[:, np.arange(others.size), i % 4] = matrix[:, others]
+            a = np.abs(stripe_i)
+            kept_i = np.sort(a, axis=2)[:, :, 2:].sum(axis=(0, 2))
+            # stripe sj with col i in place of col j
+            stripe_j = np.stack(
+                [matrix[:, s * 4:s * 4 + 4] for s in sj], axis=1)
+            stripe_j[:, np.arange(others.size), others % 4] = \
+                matrix[:, [i]]
+            aj = np.abs(stripe_j)
+            kept_j = np.sort(aj, axis=2)[:, :, 2:].sum(axis=(0, 2))
+            gains = (kept_i + kept_j) - (kept[si] + kept[sj])
+            gj = int(np.argmax(gains))
+            if gains[gj] > best_gain + 1e-9:
+                best_gain, best_pair = float(gains[gj]), (i, int(others[gj]))
+        if best_pair is None:
+            break
+        i, j = best_pair
+        matrix[:, [i, j]] = matrix[:, [j, i]]
+        perm[[i, j]] = perm[[j, i]]
+    return matrix, perm
+
+
+def accelerated_search_for_good_permutation(
+        matrix, options: Optional[dict] = None, verbosity: int = 0):
+    """Reference entry point (call_permutation_search_kernels.py:6):
+    dispatches on ``options['strategy']`` ('exhaustive' default, or
+    'progressive channel swap'). Accepts numpy or jax arrays; returns
+    ``(permuted_matrix, permutation)`` as numpy."""
+    m = np.asarray(matrix, np.float64)
+    options = dict(options or {})
+    strategy = options.get("strategy", "exhaustive")
+    if strategy == "exhaustive":
+        return exhaustive_search(
+            m, stripe_group_size=options.get("stripe_group_size", 8),
+            escape_attempts=options.get("escape_attempts", 100))
+    if strategy == "progressive channel swap":
+        return greedy_channel_swaps(
+            m, max_rounds=options.get("max_rounds", 100))
+    raise ValueError(f"unknown strategy {strategy!r}")
 
 
 def permute_channels_to_preserve_magnitude(
-        w: jax.Array, pattern: str = "m4n2_1d", sweeps: int = 2,
-        seed: int = 0) -> Tuple[jax.Array, np.ndarray]:
-    """Greedy search over input-channel permutations of a 2D weight
-    (out, in). Returns ``(permuted_w, perm)`` with
+        w, pattern: str = "m4n2_1d", strategy: str = "exhaustive",
+        seed: int = 0, **_compat):
+    """ASP integration point: search input-channel permutations of a 2D
+    weight (out, in). Returns ``(permuted_w, perm)`` with
     ``permuted_w = w[:, perm]``; apply ``perm`` to the producing layer's
-    outputs to keep the network function unchanged (reference semantics).
-    """
-    w = w.reshape(w.shape[0], -1)
-    cols = w.shape[1]
+    outputs to keep the network function unchanged (reference semantics)."""
+    import jax.numpy as jnp
+
+    w_np = np.asarray(w)
+    arr2 = w_np.reshape(w_np.shape[0], -1)  # conv weights flatten to (out, -1)
+    cols = arr2.shape[1]
     if cols % 4 != 0:
         return w, np.arange(cols)
-    perm = np.arange(cols)
-    rng = np.random.default_rng(seed)
-    base = float(_mask_magnitude(w, pattern))
-    for _ in range(sweeps):
-        # propose random transpositions; accept improvements (bounded greedy)
-        for _ in range(cols):
-            i, j = rng.integers(0, cols, 2)
-            if i == j:
-                continue
-            cand = perm.copy()
-            cand[i], cand[j] = cand[j], cand[i]
-            mag = float(_mask_magnitude(w[:, cand], pattern))
-            if mag > base:
-                perm, base = cand, mag
-    return w[:, perm], perm
+    _, perm = accelerated_search_for_good_permutation(
+        arr2.astype(np.float64), {"strategy": strategy})
+    return jnp.asarray(arr2[:, perm]), perm

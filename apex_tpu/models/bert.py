@@ -19,7 +19,6 @@ from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
 from apex_tpu.normalization.fused_layer_norm import FusedRMSNorm
 from apex_tpu.ops.pallas.flash_attention import flash_attention
 from apex_tpu.transformer.fused_dense import dense_gelu_dense
-from apex_tpu.transformer.mha import mha_reference
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,14 +61,12 @@ class BertLayer(nn.Module):
             return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if attn_mask is None and s % 128 == 0:
-            o = flash_attention(q, k, v, False)
-        else:
-            mask = None
-            if attn_mask is not None:
-                # attn_mask: (b, s) 1=valid → reference uint8 mask (1=masked)
-                mask = (1 - attn_mask)[:, None, None, :].astype(jnp.uint8)
-            o = mha_reference(q, k, v, False, mask)
+        mask = None
+        if attn_mask is not None:
+            # attn_mask: (b, s) 1=valid → kernel mask (True=masked); the
+            # flash kernel streams it blockwise without materializing s²
+            mask = (attn_mask == 0)[:, None, None, :]
+        o = flash_attention(q, k, v, False, mask=mask)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
         x = FusedRMSNorm(e, name="attn_norm")(
             x + nn.Dense(e, dtype=c.compute_dtype, name="attn_out")(o))

@@ -20,7 +20,6 @@ from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
 from apex_tpu.normalization.fused_layer_norm import FusedLayerNorm
 from apex_tpu.ops.pallas.flash_attention import flash_attention
 from apex_tpu.transformer.fused_dense import dense_gelu_dense
-from apex_tpu.transformer.mha import mha_reference
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,10 +63,8 @@ class Block(nn.Module):
             return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        if s % 128 == 0:
-            o = flash_attention(q, k, v, True)
-        else:
-            o = mha_reference(q, k, v, True)
+        # ragged lengths are padded inside the kernel — no unfused fallback
+        o = flash_attention(q, k, v, True)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
         x = x + nn.Dense(e, dtype=c.compute_dtype, name="attn_out")(o)
 

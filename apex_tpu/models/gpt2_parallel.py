@@ -13,8 +13,18 @@ Composition (the 'How to Scale Your Model' recipe, hand-annotated):
   (apex_tpu.parallel.ring_attention) so K/V shards rotate over ICI while Q
   stays resident; positional embeddings sharded with the sequence.
 
-Pipeline (pp) and expert (ep) axes: not yet wired (round-1 scope; the mesh
-helper accepts them as size-1 axes so the step signature is stable).
+Round 2 composes the remaining two axes (VERDICT item 5):
+- **PP**: ``make_train_step_pp`` runs the block stack through the 1F1B
+  pipeline (apex_tpu.parallel.pipeline.pipeline_train_1f1b) over the ``pp``
+  axis — blocks stacked with a leading layer dim sharded over pp, embeddings
+  and final-LN shared (replicated over pp, grads psum'd), the last stage
+  computing the loss so cotangents enter the reverse pipeline on-device.
+- **EP**: ``moe_experts > 0`` replaces the dense FFN with the
+  expert-parallel MoE FFN (apex_tpu.parallel.moe.moe_ffn_ep) over the ``ep``
+  axis, expert weights sharded (pp, ep, ...).
+
+All five axes compose in one mesh (dp, pp, tp, sp, ep); degenerate (size-1)
+axes cost nothing, so one train step covers every combination.
 
 All params/optimizer state live in fp32; compute in bf16 (amp O1 shape);
 optimizer is the fused Adam tree update (optimizers/functional.py).
@@ -131,6 +141,51 @@ def _grad_sync_specs(cfg: GPT2Config) -> Dict[str, Any]:
     }
 
 
+def _block_apply(cfg: GPT2Config, blk, x):
+    """One transformer block on a local activation shard (b, s_local, e).
+
+    TP: column-parallel q/k/v + row-parallel output with psum over tp;
+    SP: ring attention over sp; EP: when the block carries expert weights
+    ("gate_w"/"w1"/"w2"), the FFN is the expert-parallel MoE over ep.
+    """
+    cd = cfg.compute_dtype
+    e = cfg.n_embd
+    tp = jax.lax.axis_size("tp")
+    h_local = cfg.n_head // tp
+    d = e // cfg.n_head
+    b, s_local, _ = x.shape
+
+    y = fused_layer_norm_affine(x, blk["ln1_w"], blk["ln1_b"], e)
+    q = (y @ blk["wq"].astype(cd))
+    k = (y @ blk["wk"].astype(cd))
+    v = (y @ blk["wv"].astype(cd))
+
+    def heads(t):
+        return t.reshape(b, s_local, h_local, d).transpose(0, 2, 1, 3)
+
+    o = ring_self_attention(heads(q), heads(k), heads(v), "sp",
+                            causal=True)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s_local, h_local * d)
+    # row-parallel output projection: partial matmul + psum over tp
+    attn = jax.lax.psum(o @ blk["wo"].astype(cd), "tp")
+    x = x + attn
+
+    y = fused_layer_norm_affine(x, blk["ln2_w"], blk["ln2_b"], e)
+    if "gate_w" in blk:
+        # expert-parallel MoE FFN over ep (parallel/moe.py)
+        from apex_tpu.parallel.moe import moe_ffn_ep
+
+        y2 = y.reshape(b * s_local, e).astype(jnp.float32)
+        mlp = moe_ffn_ep(y2, blk["gate_w"], blk["w1"], blk["w2"], "ep")
+        x = x + mlp.reshape(b, s_local, e).astype(x.dtype)
+    else:
+        hmid = jax.nn.gelu(y @ blk["fc_w"].astype(cd)
+                           + blk["fc_b"].astype(cd), approximate=False)
+        mlp = jax.lax.psum(hmid @ blk["proj_w"].astype(cd), "tp")
+        x = x + (mlp + blk["proj_b"].astype(cd))
+    return x
+
+
 def _forward_local(cfg: GPT2Config, params, tokens, targets, mask):
     """Per-shard forward: tokens (b_local, s_local) on a (dp, tp, sp) mesh."""
     cd = cfg.compute_dtype
@@ -150,26 +205,7 @@ def _forward_local(cfg: GPT2Config, params, tokens, targets, mask):
     b, s_local, _ = x.shape
 
     for blk in params["blocks"]:
-        y = fused_layer_norm_affine(x, blk["ln1_w"], blk["ln1_b"], e)
-        q = (y @ blk["wq"].astype(cd))
-        k = (y @ blk["wk"].astype(cd))
-        v = (y @ blk["wv"].astype(cd))
-
-        def heads(t):
-            return t.reshape(b, s_local, h_local, d).transpose(0, 2, 1, 3)
-
-        o = ring_self_attention(heads(q), heads(k), heads(v), "sp",
-                                causal=True)
-        o = o.transpose(0, 2, 1, 3).reshape(b, s_local, h_local * d)
-        # row-parallel output projection: partial matmul + psum over tp
-        attn = jax.lax.psum(o @ blk["wo"].astype(cd), "tp")
-        x = x + attn
-
-        y = fused_layer_norm_affine(x, blk["ln2_w"], blk["ln2_b"], e)
-        hmid = jax.nn.gelu(y @ blk["fc_w"].astype(cd)
-                           + blk["fc_b"].astype(cd), approximate=False)
-        mlp = jax.lax.psum(hmid @ blk["proj_w"].astype(cd), "tp")
-        x = x + (mlp + blk["proj_b"].astype(cd))
+        x = _block_apply(cfg, blk, x)
 
     x = fused_layer_norm_affine(x, params["lnf_w"], params["lnf_b"], e)
     logits = jax.lax.dot_general(x, params["wte"].astype(cd),
@@ -238,3 +274,188 @@ def init_opt_state(params):
     z = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, _f32), params)
     z2 = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, _f32), params)
     return (z, z2)
+
+
+# ------------------------------------------------------------ pp/ep (round 2)
+
+
+def init_params_pp(cfg: GPT2Config, key, moe_experts: int = 0):
+    """Params for the pipelined model: blocks STACKED (leading n_layer dim,
+    sharded over pp), embeddings/final-LN shared. ``moe_experts > 0`` builds
+    expert-parallel FFNs (gate + per-expert w1/w2) instead of dense fc/proj."""
+    p = init_params(cfg, key)
+    blocks = p.pop("blocks")
+    if moe_experts:
+        e = cfg.n_embd
+        ks = jax.random.split(jax.random.fold_in(key, 17),
+                              3 * cfg.n_layer)
+        for i, blk in enumerate(blocks):
+            for k_ in ("fc_w", "fc_b", "proj_w", "proj_b"):
+                del blk[k_]
+            std = 0.02
+            blk["gate_w"] = jax.random.normal(
+                ks[3 * i], (e, moe_experts), _f32) * std
+            blk["w1"] = jax.random.normal(
+                ks[3 * i + 1], (moe_experts, e, 4 * e), _f32) * std
+            blk["w2"] = jax.random.normal(
+                ks[3 * i + 2], (moe_experts, 4 * e, e), _f32) * std \
+                / math.sqrt(2 * cfg.n_layer)
+    stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *blocks)
+    shared = {"wte": p["wte"], "wpe": p["wpe"],
+              "lnf_w": p["lnf_w"], "lnf_b": p["lnf_b"]}
+    return {"blocks": stacked, "shared": shared}
+
+
+def param_specs_pp(cfg: GPT2Config, moe_experts: int = 0):
+    """PartitionSpecs for the pipelined layout: leading layer dim over pp,
+    TP/EP dims inside, shared params replicated over pp."""
+    col = P("pp", None, "tp")
+    row = P("pp", "tp", None)
+    rep = P("pp")
+    block = {
+        "ln1_w": rep, "ln1_b": rep,
+        "wq": col, "wk": col, "wv": col, "wo": row,
+        "ln2_w": rep, "ln2_b": rep,
+    }
+    if moe_experts:
+        block.update({
+            "gate_w": P("pp", None, None),
+            "w1": P("pp", "ep", None, None),
+            "w2": P("pp", "ep", None, None),
+        })
+    else:
+        block.update({
+            "fc_w": col, "fc_b": P("pp", "tp"),
+            "proj_w": row, "proj_b": rep,
+        })
+    shared = {"wte": P(), "wpe": P("sp", None), "lnf_w": P(), "lnf_b": P()}
+    return {"blocks": block, "shared": shared}
+
+
+def _grad_sync_specs_pp(cfg: GPT2Config, moe_experts: int = 0):
+    """Axes (|-joined) each grad must be psum'd over in the pp layout.
+    Blocks are pp-sharded so never synced over pp; the pipeline already
+    psums shared grads over pp internally."""
+    tp_sharded = "dp|sp|ep" if moe_experts else "dp|sp"
+    replicated = "dp|sp|tp|ep" if moe_experts else "dp|sp|tp"
+    block = {
+        "ln1_w": replicated, "ln1_b": replicated,
+        "wq": tp_sharded, "wk": tp_sharded, "wv": tp_sharded,
+        "wo": tp_sharded,
+        "ln2_w": replicated, "ln2_b": replicated,
+    }
+    if moe_experts:
+        block.update({"gate_w": replicated,
+                      "w1": "dp|sp|tp", "w2": "dp|sp|tp"})
+    else:
+        block.update({"fc_w": tp_sharded, "fc_b": tp_sharded,
+                      "proj_w": tp_sharded, "proj_b": replicated})
+    shared = {"wte": replicated, "wpe": "dp|tp|ep" if moe_experts
+              else "dp|tp", "lnf_w": replicated, "lnf_b": replicated}
+    return {"blocks": block, "shared": shared}
+
+
+def make_train_step_pp(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
+                       num_microbatches: int = 4, moe_experts: int = 0):
+    """Composed 5-axis (dp, pp, tp, sp, ep) train step: 1F1B pipeline over
+    pp wrapping the dp×tp×sp(×ep) block stack. Returns jitted
+    train_step(params, opt_state, tokens, targets, mask, step) →
+    (params, opt_state, loss)."""
+    from apex_tpu.parallel.pipeline import pipeline_train_1f1b
+
+    pspecs = param_specs_pp(cfg, moe_experts)
+    sync_axes = _grad_sync_specs_pp(cfg, moe_experts)
+    pp = mesh.shape["pp"]
+    assert cfg.n_layer % pp == 0, \
+        "pp (pipeline stages) must divide n_layer evenly"
+    cd = cfg.compute_dtype
+    e = cfg.n_embd
+    M = num_microbatches
+
+    def local_step(blocks, shared, m, v, tokens, targets, mask, step):
+        b_local, s_local = tokens.shape
+        assert b_local % M == 0, "num_microbatches must divide local batch"
+        mb = b_local // M
+        micro = tuple(a.reshape(M, mb, s_local)
+                      for a in (tokens, targets, mask))
+        x_template = jnp.zeros((mb, s_local, e), cd)
+        # GLOBAL valid-token count (all microbatches): per-microbatch losses
+        # are tot_i / cnt_total so their sum is the exact global token mean
+        # (per-microbatch normalization would overweight sparse microbatches)
+        cnt_total = jnp.maximum(jax.lax.psum(jax.lax.psum(
+            jnp.sum(mask), "dp"), "sp"), 1.0)
+
+        def stage_fn(stage_blocks, shared_, x_act, tok, tgt, msk):
+            my_pp = jax.lax.axis_index("pp")
+            last = my_pp == jax.lax.axis_size("pp") - 1
+            x0 = (shared_["wte"][tok].astype(cd)
+                  + shared_["wpe"][None].astype(cd))
+            x = jnp.where(my_pp == 0, x0, x_act)
+            lps = cfg.n_layer // pp
+            for i in range(lps):
+                blk = jax.tree_util.tree_map(lambda l: l[i], stage_blocks)
+                x = _block_apply(cfg, blk, x)
+
+            def loss_of(xv):
+                y = fused_layer_norm_affine(xv, shared_["lnf_w"],
+                                            shared_["lnf_b"], e)
+                logits = jax.lax.dot_general(
+                    y, shared_["wte"].astype(cd), (((2,), (1,)), ((), ())),
+                    preferred_element_type=_f32)
+                loss_tok = softmax_cross_entropy_loss(logits, tgt)
+                tot = jax.lax.psum(jax.lax.psum(
+                    jnp.sum(loss_tok * msk), "dp"), "sp")
+                return tot / cnt_total
+
+            # only the last stage pays the vocab matmul (lax.cond: 1 branch)
+            loss_i = jax.lax.cond(last, loss_of,
+                                  lambda _: jnp.float32(0.0), x)
+            return x, loss_i
+
+        loss_sum, g_blocks, g_shared = pipeline_train_1f1b(
+            stage_fn, blocks, shared, x_template, micro, M, "pp")
+        loss = loss_sum  # already the global token mean (see cnt_total)
+
+        # grad sync + replication-factor normalization (see make_train_step:
+        # with check_vma=False each sync psum re-broadcasts the seed
+        # cotangent, giving n_total× the true grad; pp is handled inside the
+        # pipeline for shared params and absent for block params)
+        n_total = (jax.lax.axis_size("dp") * jax.lax.axis_size("tp")
+                   * jax.lax.axis_size("sp") * jax.lax.axis_size("ep"))
+
+        def sync(g, axes):
+            for ax in axes.split("|"):
+                g = jax.lax.psum(g, ax)
+            return g / n_total
+
+        g_blocks = {k_: sync(g_blocks[k_], sync_axes["blocks"][k_])
+                    for k_ in g_blocks}
+        g_shared = {k_: sync(g_shared[k_], sync_axes["shared"][k_])
+                    for k_ in g_shared}
+
+        params = {"blocks": blocks, "shared": shared}
+        grads = {"blocks": g_blocks, "shared": g_shared}
+        params, m, v = adam_update(params, grads, m, v, step=step, lr=lr,
+                                   weight_decay=0.01)
+        return params["blocks"], params["shared"], m, v, loss
+
+    bspec = pspecs["blocks"]
+    sspec = pspecs["shared"]
+    state_spec = {"blocks": bspec, "shared": sspec}
+
+    sharded = shard_map(
+        local_step, mesh=mesh,
+        in_specs=(bspec, sspec, state_spec, state_spec,
+                  P("dp", "sp"), P("dp", "sp"), P("dp", "sp"), P()),
+        out_specs=(bspec, sspec, state_spec, state_spec, P()),
+        check_vma=False)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens, targets, mask, step):
+        m, v = opt_state
+        blocks, shared, m, v, loss = sharded(
+            params["blocks"], params["shared"], m, v, tokens, targets,
+            mask, step)
+        return {"blocks": blocks, "shared": shared}, (m, v), loss
+
+    return train_step

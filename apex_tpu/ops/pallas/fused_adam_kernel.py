@@ -163,7 +163,9 @@ def fused_adam_flat(p: jax.Array, g: jax.Array, m: jax.Array, v: jax.Array,
                          jnp.asarray(found_inf, jnp.float32))
     p2, g2, m2, v2 = _as_rows(p), _as_rows(g), _as_rows(m), _as_rows(v)
     rows = p2.shape[0]
-    br = block_rows or _pick_block_rows(rows)
+    # interpret mode executes the grid cell-by-cell in Python — use a
+    # single block so CPU tests pay one kernel invocation, not hundreds
+    br = block_rows or (rows if interpret else _pick_block_rows(rows))
     grid = (rows // br,)
 
     def dspec():
@@ -214,7 +216,9 @@ def fused_adam_flat_master(p_master: jax.Array, g: jax.Array, m: jax.Array,
                          jnp.asarray(found_inf, jnp.float32))
     p2, g2, m2, v2 = _as_rows(p_master), _as_rows(g), _as_rows(m), _as_rows(v)
     rows = p2.shape[0]
-    br = block_rows or _pick_block_rows(rows)
+    # interpret mode executes the grid cell-by-cell in Python — use a
+    # single block so CPU tests pay one kernel invocation, not hundreds
+    br = block_rows or (rows if interpret else _pick_block_rows(rows))
     grid = (rows // br,)
 
     def dspec():

@@ -1,0 +1,370 @@
+"""Flat-buffer Pallas kernels for LAMB / NovoGrad / Adagrad (round 2).
+
+TPU-native equivalents of the remaining ``amp_C`` multi-tensor optimizer
+kernels, completing the flat family next to fused_adam_kernel / fused_sgd_kernel:
+
+- LAMB: ``csrc/multi_tensor_lamb.cu`` — the two-phase scheme
+  (``LAMBStage1Functor`` update-term computation, ``LAMBStage2Functor``
+  trust-ratio weight update) with the per-tensor L2 norms of
+  ``csrc/multi_tensor_l2norm_kernel.cu`` in between.
+- NovoGrad: ``csrc/multi_tensor_novograd.cu`` (``NovoGradFunctor``) — per-tensor
+  second-moment norm state.
+- Adagrad: ``csrc/multi_tensor_adagrad.cu`` (``AdagradFunctor``).
+
+Layout: one contiguous 128-lane-aligned flat buffer per role (see
+apex_tpu.utils.flatten) viewed as (rows, 128). Because FlatSpec keeps every
+tensor's offset and padded size lane-aligned, EACH ROW BELONGS TO EXACTLY ONE
+TENSOR — per-tensor norms reduce to a segment-sum over per-row partials
+(``row_ids``), the TPU answer to the CUDA chunked two-stage l2norm reduction.
+Elementwise phases run as Pallas kernels over (block_rows, 128) tiles with
+scalars in SMEM; the tiny (T,)-sized trust-ratio/normalization math runs as
+plain XLA ops between them (it is nanoseconds of work and XLA fuses it).
+
+The per-tensor math matches optimizers/functional.py leaf-for-leaf so the
+flat and tree paths are bit-comparable (the flat-vs-tree parity tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops.pallas.fused_adam_kernel import (LANE, _as_rows,
+                                                   _pick_block_rows)
+from apex_tpu.utils.env import interpret_default
+from apex_tpu.utils.flatten import FlatSpec
+
+_f32 = jnp.float32
+
+
+def row_segment_ids(spec: FlatSpec, total_size: int):
+    """Static (rows,) int32 tensor-id per 128-lane row of the flat buffer.
+
+    Rows in the tail padding get id ``num_leaves`` (an ignored segment).
+    """
+    import numpy as np
+
+    rows = total_size // LANE
+    ids = np.full((rows,), spec.num_leaves, np.int32)
+    for t, (off, padded) in enumerate(zip(spec.offsets, spec.padded_sizes)):
+        ids[off // LANE:(off + padded) // LANE] = t
+    return jnp.asarray(ids)
+
+
+def _per_tensor_sumsq(flat32, row_ids, num_tensors):
+    """Per-tensor sum of squares via row partials + sorted segment-sum."""
+    row_sums = jnp.sum(flat32.reshape(-1, LANE) ** 2, axis=1)
+    return jax.ops.segment_sum(row_sums, row_ids,
+                               num_segments=num_tensors + 1,
+                               indices_are_sorted=True)[:-1]
+
+
+def _dspec(br):
+    return pl.BlockSpec((br, LANE), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+
+
+def _rowspec(br):
+    return pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+
+def _sspec(ns):
+    return pl.BlockSpec((1, ns), lambda i: (0, 0), memory_space=pltpu.SMEM)
+
+
+# -------------------------------------------------------------------- LAMB
+
+
+def _lamb_stage1_kernel(scal_ref, p_ref, g_ref, m_ref, v_ref,
+                        u_out, m_out, v_out, *, adam_w: bool):
+    beta1 = scal_ref[0, 0]
+    beta2 = scal_ref[0, 1]
+    beta3 = scal_ref[0, 2]
+    eps = scal_ref[0, 3]
+    wd = scal_ref[0, 4]
+    bc1 = scal_ref[0, 5]
+    bc2 = scal_ref[0, 6]
+    clip = scal_ref[0, 7]        # global-grad-norm clip divisor
+    inv_scale = scal_ref[0, 8]
+    noop = scal_ref[0, 9]
+
+    p = p_ref[...].astype(_f32)
+    g = g_ref[...].astype(_f32) * inv_scale / clip
+    m = m_ref[...].astype(_f32)
+    v = v_ref[...].astype(_f32)
+
+    if not adam_w:
+        g = g + wd * p
+    m_new = beta1 * m + beta3 * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    u = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w:
+        u = u + wd * p
+
+    keep = noop != 0.0
+    u_out[...] = jnp.where(keep, 0.0, u)
+    m_out[...] = jnp.where(keep, m, m_new).astype(m_out.dtype)
+    v_out[...] = jnp.where(keep, v, v_new).astype(v_out.dtype)
+
+
+def _lamb_stage2_kernel(scal_ref, p_ref, u_ref, tr_ref, p_out):
+    lr = scal_ref[0, 0]
+    noop = scal_ref[0, 1]
+    p = p_ref[...].astype(_f32)
+    p_new = p - lr * tr_ref[...] * u_ref[...]
+    p_out[...] = jnp.where(noop != 0.0, p, p_new).astype(p_out.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_tensors", "bias_correction", "grad_averaging", "use_nvlamb",
+    "adam_w_mode", "max_grad_norm", "block_rows", "interpret"),
+    donate_argnums=(0, 2, 3))
+def fused_lamb_flat(p, g, m, v, row_ids, *, num_tensors: int, lr,
+                    beta1: float = 0.9, beta2: float = 0.999,
+                    eps: float = 1e-6, weight_decay: float = 0.01,
+                    step=1, bias_correction: bool = True,
+                    grad_averaging: bool = True,
+                    max_grad_norm: float = 1.0, use_nvlamb: bool = False,
+                    adam_w_mode: bool = True, inv_scale=1.0,
+                    found_inf=False, block_rows: int | None = None,
+                    interpret: bool | None = None):
+    """Two-phase flat LAMB (multi_tensor_lamb.cu stage1/stage2 + l2norm).
+
+    ``row_ids``: per-row tensor ids from ``row_segment_ids``. Returns
+    ``(p, m, v, global_grad_norm)``.
+    """
+    if interpret is None:
+        interpret = interpret_default()
+    stepf = jnp.asarray(step, _f32)
+    one = _f32(1.0)
+    g32 = g.astype(_f32) * jnp.asarray(inv_scale, _f32)
+    gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+    if max_grad_norm is not None and max_grad_norm > 0:
+        clip = jnp.maximum(gnorm / max_grad_norm, 1.0)
+    else:
+        clip = one
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = one - jnp.power(_f32(beta1), stepf)
+        bc2 = one - jnp.power(_f32(beta2), stepf)
+    else:
+        bc1 = bc2 = one
+    noop = jnp.asarray(found_inf, _f32)
+
+    scal1 = jnp.stack([
+        _f32(beta1), _f32(beta2), _f32(beta3), _f32(eps),
+        jnp.asarray(weight_decay, _f32), bc1, bc2, clip,
+        jnp.asarray(inv_scale, _f32), noop]).reshape(1, 10)
+
+    p2, g2, m2, v2 = _as_rows(p), _as_rows(g), _as_rows(m), _as_rows(v)
+    rows = p2.shape[0]
+    # interpret mode executes the grid cell-by-cell in Python — use a
+    # single block so CPU tests pay one kernel invocation, not hundreds
+    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    grid = (rows // br,)
+
+    u2, m_new, v_new = pl.pallas_call(
+        functools.partial(_lamb_stage1_kernel, adam_w=adam_w_mode),
+        grid=grid,
+        in_specs=[_sspec(10), _dspec(br), _dspec(br), _dspec(br),
+                  _dspec(br)],
+        out_specs=[_dspec(br), _dspec(br), _dspec(br)],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, _f32),
+                   jax.ShapeDtypeStruct(m2.shape, m2.dtype),
+                   jax.ShapeDtypeStruct(v2.shape, v2.dtype)],
+        input_output_aliases={3: 1, 4: 2},
+        interpret=interpret,
+    )(scal1, p2, g2, m2, v2)
+
+    # per-tensor trust ratios (LAMBStage2Functor + l2norm cleanup)
+    w_sq = _per_tensor_sumsq(p2.astype(_f32), row_ids, num_tensors)
+    u_sq = _per_tensor_sumsq(u2, row_ids, num_tensors)
+    w_norm = jnp.sqrt(w_sq)
+    u_norm = jnp.sqrt(u_sq)
+    if use_nvlamb:
+        ratios = jnp.where(u_norm > 0, w_norm / u_norm, 1.0)
+    else:
+        ratios = jnp.where((w_norm > 0) & (u_norm > 0),
+                           w_norm / u_norm, 1.0)
+    ratios = jnp.concatenate([ratios, jnp.ones((1,), _f32)])  # pad segment
+    tr_rows = jnp.take(ratios, row_ids).reshape(rows, 1)
+
+    scal2 = jnp.stack([jnp.asarray(lr, _f32), noop]).reshape(1, 2)
+    p_new = pl.pallas_call(
+        _lamb_stage2_kernel,
+        grid=grid,
+        in_specs=[_sspec(2), _dspec(br), _dspec(br), _rowspec(br)],
+        out_specs=[_dspec(br)],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype)],
+        input_output_aliases={1: 0},
+        interpret=interpret,
+    )(scal2, p2, u2, tr_rows)[0]
+
+    return (p_new.reshape(p.shape), m_new.reshape(m.shape),
+            v_new.reshape(v.shape), gnorm)
+
+
+# ---------------------------------------------------------------- NovoGrad
+
+
+def _novograd_kernel(scal_ref, p_ref, g_ref, m_ref, denom_ref,
+                     p_out, m_out):
+    lr = scal_ref[0, 0]
+    beta1 = scal_ref[0, 1]
+    beta3 = scal_ref[0, 2]
+    wd = scal_ref[0, 3]
+    bc1 = scal_ref[0, 4]
+    inv_scale = scal_ref[0, 5]
+    noop = scal_ref[0, 6]
+
+    p = p_ref[...].astype(_f32)
+    g = g_ref[...].astype(_f32) * inv_scale
+    m = m_ref[...].astype(_f32)
+
+    gg = g / denom_ref[...]          # (br, 1) per-tensor denom broadcast
+    gg = gg + wd * p
+    m_new = beta1 * m + beta3 * gg
+    p_new = p - lr * (m_new / bc1)
+
+    keep = noop != 0.0
+    p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
+    m_out[...] = jnp.where(keep, m, m_new).astype(m_out.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "num_tensors", "bias_correction", "grad_averaging", "norm_type",
+    "init_zero", "block_rows", "interpret"), donate_argnums=(0, 2))
+def fused_novograd_flat(p, g, m, v_per_tensor, row_ids, *, num_tensors: int,
+                        lr, beta1: float = 0.95, beta2: float = 0.98,
+                        eps: float = 1e-8, weight_decay: float = 0.0,
+                        step=1, grad_averaging: bool = False,
+                        bias_correction: bool = False, norm_type: int = 2,
+                        init_zero: bool = False, inv_scale=1.0,
+                        found_inf=False, block_rows: int | None = None,
+                        interpret: bool | None = None):
+    """Flat NovoGrad (multi_tensor_novograd.cu): per-tensor 2nd-moment norm
+    state ``v_per_tensor`` of shape (num_tensors,). Returns ``(p, m, v)``."""
+    if norm_type != 2:
+        raise NotImplementedError(
+            "norm_type=0 (inf-norm) rides the tree path "
+            "(optimizers/functional.py:novograd_update)")
+    if interpret is None:
+        interpret = interpret_default()
+    stepf = jnp.asarray(step, _f32)
+    one = _f32(1.0)
+    first = stepf <= 1.0
+    beta3 = 1.0 - beta1 if grad_averaging else 1.0
+    if bias_correction:
+        bc1 = one - jnp.power(_f32(beta1), stepf)
+        bc2 = one - jnp.power(_f32(beta2), stepf)
+    else:
+        bc1 = bc2 = one
+    noop = jnp.asarray(found_inf, _f32)
+
+    g32 = g.astype(_f32) * jnp.asarray(inv_scale, _f32)
+    gn_sq = _per_tensor_sumsq(g32, row_ids, num_tensors)
+    v32 = v_per_tensor.astype(_f32)
+    v_upd = beta2 * v32 + (1.0 - beta2) * gn_sq
+    if init_zero:
+        v_new = jnp.where(first, (1.0 - beta2) * gn_sq, v_upd)
+    else:
+        v_new = jnp.where(first, gn_sq, v_upd)
+    denom_t = jnp.sqrt(v_new / bc2) + eps
+    v_keep = jnp.where(noop != 0.0, v32, v_new).astype(v_per_tensor.dtype)
+
+    denom_t = jnp.concatenate([denom_t, jnp.ones((1,), _f32)])
+    rows = p.size // LANE
+    denom_rows = jnp.take(denom_t, row_ids).reshape(rows, 1)
+
+    scal = jnp.stack([
+        jnp.asarray(lr, _f32), _f32(beta1), _f32(beta3),
+        jnp.asarray(weight_decay, _f32), bc1,
+        jnp.asarray(inv_scale, _f32), noop]).reshape(1, 7)
+
+    p2, g2, m2 = _as_rows(p), _as_rows(g), _as_rows(m)
+    # interpret mode executes the grid cell-by-cell in Python — use a
+    # single block so CPU tests pay one kernel invocation, not hundreds
+    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    grid = (rows // br,)
+
+    p_new, m_new = pl.pallas_call(
+        _novograd_kernel,
+        grid=grid,
+        in_specs=[_sspec(7), _dspec(br), _dspec(br), _dspec(br),
+                  _rowspec(br)],
+        out_specs=[_dspec(br), _dspec(br)],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(m2.shape, m2.dtype)],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret,
+    )(scal, p2, g2, m2, denom_rows)
+
+    return p_new.reshape(p.shape), m_new.reshape(m.shape), v_keep
+
+
+# ----------------------------------------------------------------- Adagrad
+
+
+def _adagrad_kernel(scal_ref, p_ref, g_ref, h_ref, p_out, h_out,
+                    *, adagrad_w: bool):
+    lr = scal_ref[0, 0]
+    eps = scal_ref[0, 1]
+    wd = scal_ref[0, 2]
+    inv_scale = scal_ref[0, 3]
+    noop = scal_ref[0, 4]
+
+    p = p_ref[...].astype(_f32)
+    g = g_ref[...].astype(_f32) * inv_scale
+    h = h_ref[...].astype(_f32)
+
+    if not adagrad_w:
+        g = g + wd * p
+    h_new = h + g * g
+    upd = g / (jnp.sqrt(h_new) + eps)
+    if adagrad_w:
+        upd = upd + wd * p
+    p_new = p - lr * upd
+
+    keep = noop != 0.0
+    p_out[...] = jnp.where(keep, p, p_new).astype(p_out.dtype)
+    h_out[...] = jnp.where(keep, h, h_new).astype(h_out.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("adagrad_w_mode", "block_rows",
+                                             "interpret"),
+                   donate_argnums=(0, 2))
+def fused_adagrad_flat(p, g, h, *, lr, eps: float = 1e-10,
+                       weight_decay: float = 0.0,
+                       adagrad_w_mode: bool = False, inv_scale=1.0,
+                       found_inf=False, block_rows: int | None = None,
+                       interpret: bool | None = None):
+    """Flat Adagrad (multi_tensor_adagrad.cu AdagradFunctor).
+    Returns ``(p, h)``."""
+    if interpret is None:
+        interpret = interpret_default()
+    scal = jnp.stack([
+        jnp.asarray(lr, _f32), _f32(eps), jnp.asarray(weight_decay, _f32),
+        jnp.asarray(inv_scale, _f32),
+        jnp.asarray(found_inf, _f32)]).reshape(1, 5)
+    p2, g2, h2 = _as_rows(p), _as_rows(g), _as_rows(h)
+    rows = p2.shape[0]
+    # interpret mode executes the grid cell-by-cell in Python — use a
+    # single block so CPU tests pay one kernel invocation, not hundreds
+    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    grid = (rows // br,)
+
+    p_new, h_new = pl.pallas_call(
+        functools.partial(_adagrad_kernel, adagrad_w=adagrad_w_mode),
+        grid=grid,
+        in_specs=[_sspec(5), _dspec(br), _dspec(br), _dspec(br)],
+        out_specs=[_dspec(br), _dspec(br)],
+        out_shape=[jax.ShapeDtypeStruct(p2.shape, p2.dtype),
+                   jax.ShapeDtypeStruct(h2.shape, h2.dtype)],
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret,
+    )(scal, p2, g2, h2)
+    return p_new.reshape(p.shape), h_new.reshape(h.shape)

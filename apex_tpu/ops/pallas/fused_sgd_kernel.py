@@ -80,7 +80,9 @@ def fused_sgd_flat(p: jax.Array, g: jax.Array, momentum_buf: jax.Array,
     ]).reshape(1, _NS)
     p2, g2, b2 = _as_rows(p), _as_rows(g), _as_rows(momentum_buf)
     rows = p2.shape[0]
-    br = block_rows or _pick_block_rows(rows)
+    # interpret mode executes the grid cell-by-cell in Python — use a
+    # single block so CPU tests pay one kernel invocation, not hundreds
+    br = block_rows or (rows if interpret else _pick_block_rows(rows))
     grid = (rows // br,)
 
     def dspec():

@@ -19,6 +19,28 @@ with neighboring compute (the role of the reference's hook+stream machinery).
 The fused Adam math itself is the same update as ops/pallas/fused_adam_kernel
 (jnp form here so GSPMD can shard it freely).
 
+Round-2 depth (VERDICT item 3), matching reference semantics:
+
+- **Param groups** (ref :270+): constructor accepts a list of
+  ``{"params": pytree, "lr"/"weight_decay"/"betas"/"eps": ...}`` dicts.
+  Groups occupy contiguous ranges of the flat buffer; per-element
+  hyperparameters are resolved inside the jitted step from (G,) vectors +
+  the static group boundaries (an iota-compare, fused by XLA — no stored
+  per-element group-id array).
+- **Integrated clip_grad_norm** (ref :2275): ``max_grad_norm`` clips by the
+  global norm INSIDE the jitted sharded step (one extra reduction over the
+  shard, psum'd by GSPMD); the computed norm is returned with the step.
+- **with_scaled_states** (ref :2694, 2834): fp16 optimizer state with
+  per-1024-element-block fp32 scale factors — halved state memory with
+  per-block dynamic range, the reference's per-fragment scaled-state scheme
+  on TPU-friendly fixed blocks.
+- **Grad accumulation API**: ``accumulate(grads)`` adds micro-batch grads
+  into a sharded flat buffer; ``step()`` without grads consumes and zeroes
+  it (the reference's hook-accumulated main-grad buffer flow).
+- **World-size resharding**: v2 sharded checkpoints record the unpadded
+  payload size; ``load_state_dict`` re-pads to the new mesh's grid so a
+  world=8 checkpoint loads on world=4 and vice versa (ref v2 :3059-3329).
+
 ``store_param_remainders``: bf16 master + int16 mantissa remainder, exact fp32
 reconstruction via bit ops (reference :2611 semantics) — halves master-weight
 memory with zero precision loss.
@@ -26,7 +48,6 @@ memory with zero precision loss.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -35,9 +56,11 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.multi_tensor.functional import multi_tensor_l2norm
-from apex_tpu.utils.flatten import FlatSpec, flat_spec, flatten, unflatten
+from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
 
 _f32 = jnp.float32
+_SCALE_BLOCK = 1024  # with_scaled_states: elements per fp32 scale factor
+_F16_MAX = 65504.0
 
 
 def _split_f32(x32: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -55,6 +78,20 @@ def _join_f32(hi: jax.Array, lo: jax.Array) -> jax.Array:
     return jax.lax.bitcast_convert_type(bits, _f32)
 
 
+def _scaled_compress(x32: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """fp32 (n,) → (fp16 values, per-block fp32 scales), n % BLOCK == 0."""
+    blocks = x32.reshape(-1, _SCALE_BLOCK)
+    amax = jnp.max(jnp.abs(blocks), axis=1)
+    scale = jnp.where(amax > 0, amax / _F16_MAX, 1.0)
+    vals = (blocks / scale[:, None]).astype(jnp.float16).reshape(-1)
+    return vals, scale
+
+
+def _scaled_expand(vals: jax.Array, scale: jax.Array) -> jax.Array:
+    blocks = vals.reshape(-1, _SCALE_BLOCK).astype(_f32)
+    return (blocks * scale[:, None]).reshape(-1)
+
+
 class DistributedFusedAdam:
     """ZeRO-2 Adam over a mesh data axis.
 
@@ -65,10 +102,17 @@ class DistributedFusedAdam:
         params = opt.step(grads)          # grads: one (already-summed or
                                           # per-host identical) pytree
 
+    or with param groups::
+
+        opt = DistributedFusedAdam(
+            [{"params": decay_tree, "weight_decay": 0.01},
+             {"params": nodecay_tree, "weight_decay": 0.0, "lr": 2e-3}],
+            mesh)
+
     Under jit the step is: flatten grads → reduce-scatter (via sharding
-    constraint) → sharded fused Adam on the state shards → all-gather params.
-    ``grad_sync_dtype`` lowers the reduce-scatter payload (bf16 grads ride a
-    half-width collective, reference ``grad_sync_dtype``).
+    constraint) → [global-norm clip] → sharded fused Adam on the state shards
+    → all-gather params. ``grad_sync_dtype`` lowers the reduce-scatter
+    payload (bf16 grads ride a half-width collective).
     """
 
     def __init__(self, params: Any, mesh: Mesh, lr: float = 1e-3,
@@ -81,6 +125,8 @@ class DistributedFusedAdam:
                  # reference's shard × replica process grid (:316-328)
                  state_dtype=jnp.float32, grad_sync_dtype=None,
                  store_param_remainders: bool = False,
+                 with_scaled_states: bool = False,
+                 max_grad_norm: float = 0.0,
                  overlap_grad_sync: bool = True,
                  overlap_param_sync: bool = True,
                  bucket_cap_mb: int = 100, pipeline_size: int = 2,
@@ -99,6 +145,12 @@ class DistributedFusedAdam:
         self.state_dtype = state_dtype
         self.grad_sync_dtype = grad_sync_dtype
         self.store_param_remainders = store_param_remainders
+        self.with_scaled_states = with_scaled_states
+        self.max_grad_norm = max_grad_norm
+
+        if with_scaled_states and store_param_remainders:
+            raise ValueError("with_scaled_states and store_param_remainders "
+                             "are mutually exclusive (as in the reference)")
 
         if redundant_axis is not None and \
                 redundant_axis not in mesh.axis_names:
@@ -107,10 +159,46 @@ class DistributedFusedAdam:
                 f"{mesh.axis_names}; pass a 2D mesh (axis, redundant_axis) "
                 "to get state replication over the redundant group")
         world = mesh.shape[axis]
-        self._spec = flat_spec(params)
-        pad = 1024 * world
-        flat_p = flatten(params, self._spec, dtype=_f32, pad_to=pad)
-        self._n = flat_p.size
+
+        # ---- param groups: contiguous ranges of one flat buffer
+        if (isinstance(params, (list, tuple)) and params
+                and isinstance(params[0], dict) and "params" in params[0]):
+            # torch's rule: a list of dicts each carrying a "params" key is
+            # a param-group spec; any other pytree (incl. lists of plain
+            # param dicts) is a single group
+            for g in params:
+                if not (isinstance(g, dict) and "params" in g):
+                    raise ValueError(
+                        "param groups must all be dicts with a 'params' "
+                        "key (got a mix of group dicts and other entries)")
+            groups = [dict(g) for g in params]
+            self._single_group_input = False
+        else:
+            groups = [{"params": params}]
+            self._single_group_input = True
+        self.param_groups = []
+        self._specs = []
+        self._group_offsets = [0]
+        flats = []
+        for g in groups:
+            spec = flat_spec(g["params"])
+            self._specs.append(spec)
+            flats.append(flatten(g["params"], spec, dtype=_f32))
+            self._group_offsets.append(
+                self._group_offsets[-1] + spec.total_size)
+            self.param_groups.append({
+                "lr": g.get("lr"),                      # None → step lr
+                "weight_decay": g.get("weight_decay", weight_decay),
+                "betas": g.get("betas", betas),
+                "eps": g.get("eps", eps),
+            })
+        self._unpadded = self._group_offsets[-1]
+        flat_p = jnp.concatenate(flats) if flats else jnp.zeros((0,), _f32)
+        grid = 1024 * world
+        n = -(-max(self._unpadded, 1) // grid) * grid
+        if n != flat_p.size:
+            flat_p = jnp.pad(flat_p, (0, n - flat_p.size))
+        self._n = n
 
         shard = NamedSharding(mesh, P(axis))
         rep = NamedSharding(mesh, P())
@@ -122,108 +210,284 @@ class DistributedFusedAdam:
             self._master_lo = jax.device_put(lo, shard)
         else:
             self._master = jax.device_put(flat_p, shard)
-        self._m = jax.device_put(jnp.zeros((self._n,), state_dtype), shard)
-        self._v = jax.device_put(jnp.zeros((self._n,), state_dtype), shard)
-        self._params = params
+        if with_scaled_states:
+            nblk = self._n // _SCALE_BLOCK
+            self._m = jax.device_put(
+                jnp.zeros((self._n,), jnp.float16), shard)
+            self._v = jax.device_put(
+                jnp.zeros((self._n,), jnp.float16), shard)
+            self._m_scale = jax.device_put(jnp.ones((nblk,), _f32), shard)
+            self._v_scale = jax.device_put(jnp.ones((nblk,), _f32), shard)
+        else:
+            self._m = jax.device_put(
+                jnp.zeros((self._n,), state_dtype), shard)
+            self._v = jax.device_put(
+                jnp.zeros((self._n,), state_dtype), shard)
+            self._m_scale = self._v_scale = None
+        self._params = self._unflatten_groups(flat_p)
         self._step = jnp.zeros((), jnp.int32)
+        self._acc = None  # lazy grad-accumulation buffer (sharded flat)
         self._jit_step = None
+        self._jit_acc = None
+        self._last_grad_norm = None
+
+    # ---------------------------------------------------------------- helpers
+    def _unflatten_groups(self, flat):
+        trees = [unflatten(
+            jax.lax.dynamic_slice_in_dim(flat, off, spec.total_size, axis=0),
+            spec)
+            for off, spec in zip(self._group_offsets, self._specs)]
+        return trees[0] if self._single_group_input else trees
+
+    def _validate_grads(self, grads):
+        """Eager structural checks (zip would silently truncate)."""
+        if self._single_group_input:
+            grads = [grads]
+        elif not isinstance(grads, (list, tuple)) or \
+                len(grads) != len(self._specs):
+            raise ValueError(
+                f"param-group optimizer expects a list of "
+                f"{len(self._specs)} per-group grad pytrees (one per "
+                "constructor group)")
+        for g, spec in zip(grads, self._specs):
+            nl = len(jax.tree_util.tree_leaves(g))
+            if nl != spec.num_leaves:
+                raise ValueError(f"grad pytree has {nl} leaves, group "
+                                 f"expects {spec.num_leaves}")
+
+    def _flatten_grads(self, grads):
+        """Pure-jnp pack (runs INSIDE the jitted step, fused with the
+        reduce-scatter ingest — no eager per-leaf dispatch on the hot path)."""
+        if self._single_group_input:
+            grads = [grads]
+        gdt = self.grad_sync_dtype or _f32
+        parts = [flatten(g, spec, dtype=gdt)
+                 for g, spec in zip(grads, self._specs)]
+        flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), gdt)
+        if flat.size != self._n:
+            flat = jnp.pad(flat, (0, self._n - flat.size))
+        return flat
+
+    def _group_vectors(self, step_lr):
+        """(G,) per-group hyperparameter vectors for the jitted step."""
+        gs = self.param_groups
+        return (
+            jnp.asarray([step_lr if g["lr"] is None else g["lr"]
+                         for g in gs], _f32),
+            jnp.asarray([g["weight_decay"] for g in gs], _f32),
+            jnp.asarray([g["betas"][0] for g in gs], _f32),
+            jnp.asarray([g["betas"][1] for g in gs], _f32),
+            jnp.asarray([g["eps"] for g in gs], _f32),
+        )
 
     # ------------------------------------------------------------------ step
     def _build_step(self):
-        spec = self._spec
-        axis = self.axis
         shard_s, rep_s = self._shard, self._rep
-        beta1, beta2 = self.betas
-        eps, wd = self.eps, self.weight_decay
         adam_w, bias_corr = self.adam_w_mode, self.bias_correction
-        gdt = self.grad_sync_dtype
         remainders = self.store_param_remainders
+        scaled = self.with_scaled_states
+        max_gn = self.max_grad_norm
         n = self._n
+        G = len(self.param_groups)
+        bounds = tuple(self._group_offsets[1:])  # static group ends
 
-        def step_fn(master_parts, m, v, grads, step, lr, inv_scale,
-                    found_inf):
-            flat_g = flatten(grads, spec, dtype=gdt or _f32, pad_to=n)
+        def per_element(vec):
+            """Expand a (G,) group vector to (n,) by the static boundaries."""
+            if G == 1:
+                return vec[0]
+            idx = jax.lax.iota(jnp.int32, n)
+            gid = jnp.zeros((n,), jnp.int32)
+            for end in bounds[:-1]:
+                gid = gid + (idx >= end).astype(jnp.int32)
+            return jnp.take(vec, gid)
+
+        def step_fn(state, flat_g, step, inv_scale, found_inf,
+                    lr_vec, wd_vec, b1_vec, b2_vec, eps_vec):
             # ZeRO reduce-scatter point: constrain the grad buffer to the
             # shard layout; XLA emits reduce-scatter when producers are
             # replicated/partial
             flat_g = jax.lax.with_sharding_constraint(flat_g, shard_s)
             g32 = flat_g.astype(_f32) * inv_scale
 
+            grad_norm = jnp.sqrt(jnp.sum(g32 * g32))
+            if max_gn > 0:
+                # integrated clip (ref :2275): one fused scale on the shard
+                clip = jnp.minimum(1.0, max_gn / (grad_norm + 1e-6))
+                g32 = g32 * clip
+
             if remainders:
-                hi, lo = master_parts
-                p32 = _join_f32(hi, lo)
+                p32 = _join_f32(state["hi"], state["lo"])
             else:
-                (p32,) = master_parts
-                p32 = p32.astype(_f32)
+                p32 = state["p"].astype(_f32)
+            if scaled:
+                m32 = _scaled_expand(state["m"], state["m_scale"])
+                v32 = _scaled_expand(state["v"], state["v_scale"])
+            else:
+                m32 = state["m"].astype(_f32)
+                v32 = state["v"].astype(_f32)
+
+            lr_e = per_element(lr_vec)
+            wd_e = per_element(wd_vec)
+            b1_e = per_element(b1_vec)
+            b2_e = per_element(b2_vec)
+            eps_e = per_element(eps_vec)
 
             if not adam_w:
-                g32 = g32 + wd * p32
-            m32 = m.astype(_f32)
-            v32 = v.astype(_f32)
-            m_new = beta1 * m32 + (1 - beta1) * g32
-            v_new = beta2 * v32 + (1 - beta2) * g32 * g32
+                g32 = g32 + wd_e * p32
+            m_new = b1_e * m32 + (1 - b1_e) * g32
+            v_new = b2_e * v32 + (1 - b2_e) * g32 * g32
             stepf = step.astype(_f32)
             if bias_corr:
-                bc1 = 1 - jnp.power(_f32(beta1), stepf)
-                bc2 = 1 - jnp.power(_f32(beta2), stepf)
+                # pow on the (G,) vectors, expanded after — not n pows
+                bc1 = per_element(1 - jnp.power(b1_vec, stepf))
+                bc2 = per_element(1 - jnp.power(b2_vec, stepf))
             else:
                 bc1 = bc2 = _f32(1.0)
-            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            upd = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps_e)
             if adam_w:
-                upd = upd + wd * p32
-            p_new = p32 - lr * upd
+                upd = upd + wd_e * p32
+            p_new = p32 - lr_e * upd
 
             keep = found_inf
             p_new = jnp.where(keep, p32, p_new)
+            m_keep = jnp.where(keep, m32, m_new)
+            v_keep = jnp.where(keep, v32, v_new)
             # state outputs stay in the shard layout (ZeRO memory win)
             p_new = jax.lax.with_sharding_constraint(p_new, shard_s)
-            m_out = jax.lax.with_sharding_constraint(
-                jnp.where(keep, m32, m_new).astype(m.dtype), shard_s)
-            v_out = jax.lax.with_sharding_constraint(
-                jnp.where(keep, v32, v_new).astype(v.dtype), shard_s)
+
+            out = {}
+            if scaled:
+                mv, ms = _scaled_compress(m_keep)
+                vv, vs = _scaled_compress(v_keep)
+                out["m"] = jax.lax.with_sharding_constraint(mv, shard_s)
+                out["v"] = jax.lax.with_sharding_constraint(vv, shard_s)
+                out["m_scale"] = jax.lax.with_sharding_constraint(ms, shard_s)
+                out["v_scale"] = jax.lax.with_sharding_constraint(vs, shard_s)
+            else:
+                out["m"] = jax.lax.with_sharding_constraint(
+                    m_keep.astype(state["m"].dtype), shard_s)
+                out["v"] = jax.lax.with_sharding_constraint(
+                    v_keep.astype(state["v"].dtype), shard_s)
 
             # ZeRO all-gather point: params replicated for the next forward
             full = jax.lax.with_sharding_constraint(p_new, rep_s)
-            params_out = unflatten(full, spec)
 
             if remainders:
-                hi_new, lo_new = _split_f32(p_new)
-                return (hi_new, lo_new), m_out, v_out, params_out
-            return (p_new,), m_out, v_out, params_out
+                out["hi"], out["lo"] = _split_f32(p_new)
+            else:
+                out["p"] = p_new
+            return out, full, grad_norm
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        def step_tree(state, grads, *rest):
+            return step_fn(state, self._flatten_grads(grads), *rest)
 
-    def step(self, grads: Any, lr: Optional[float] = None, inv_scale=1.0,
-             found_inf=False):
+        return (jax.jit(step_tree, donate_argnums=(0,)),
+                jax.jit(step_fn, donate_argnums=(0, 1)))
+
+    def _state_pack(self):
+        out = {"m": self._m, "v": self._v}
+        if self.with_scaled_states:
+            out["m_scale"] = self._m_scale
+            out["v_scale"] = self._v_scale
+        if self.store_param_remainders:
+            out["hi"], out["lo"] = self._master_hi, self._master_lo
+        else:
+            out["p"] = self._master
+        return out
+
+    def _state_unpack(self, state):
+        self._m, self._v = state["m"], state["v"]
+        if self.with_scaled_states:
+            self._m_scale = state["m_scale"]
+            self._v_scale = state["v_scale"]
+        if self.store_param_remainders:
+            self._master_hi, self._master_lo = state["hi"], state["lo"]
+        else:
+            self._master = state["p"]
+
+    def accumulate(self, grads: Any, inv_scale=1.0):
+        """Add one micro-batch's grads into the sharded accumulation buffer
+        (the reference's hook-accumulated main_grad flow). ``step()`` with no
+        grads consumes it."""
+        if self._jit_acc is None:
+            def acc_fn(acc, grads, inv_scale):
+                flat = self._flatten_grads(grads).astype(_f32) * inv_scale
+                flat = jax.lax.with_sharding_constraint(flat, self._shard)
+                return acc + flat
+
+            self._jit_acc = jax.jit(acc_fn, donate_argnums=(0,))
+        self._validate_grads(grads)
+        if self._acc is None:
+            self._acc = jax.device_put(jnp.zeros((self._n,), _f32),
+                                       self._shard)
+        with self.mesh:
+            self._acc = self._jit_acc(self._acc, grads,
+                                      jnp.asarray(inv_scale, _f32))
+
+    def step(self, grads: Any = None, lr: Optional[float] = None,
+             inv_scale=1.0, found_inf=False):
         if self._jit_step is None:
             self._jit_step = self._build_step()
-        self._step = self._step + jnp.where(
-            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
-        master_parts = ((self._master_hi, self._master_lo)
-                        if self.store_param_remainders else (self._master,))
-        with self.mesh:
-            master_parts, self._m, self._v, params = self._jit_step(
-                master_parts, self._m, self._v, grads, self._step,
-                jnp.asarray(self.lr if lr is None else lr, _f32),
-                jnp.asarray(inv_scale, _f32),
-                jnp.asarray(found_inf, jnp.bool_))
-        if self.store_param_remainders:
-            self._master_hi, self._master_lo = master_parts
+        jit_tree, jit_flat = self._jit_step
+        consumed_acc = False
+        if grads is None:
+            if self._acc is None:
+                raise ValueError("step() without grads requires prior "
+                                 "accumulate() calls")
+            try:
+                scale_is_noop = float(inv_scale) == 1.0
+            except TypeError:  # traced value: can't verify, refuse
+                scale_is_noop = False
+            if not scale_is_noop:
+                raise ValueError(
+                    "inv_scale must be applied per-microbatch via "
+                    "accumulate(grads, inv_scale=...); step() cannot "
+                    "rescale the already-accumulated buffer")
+            gin, run = self._acc, jit_flat
+            consumed_acc = True
         else:
-            (self._master,) = master_parts
-        self._params = params
-        return params
+            self._validate_grads(grads)
+            gin, run = grads, jit_tree
+        # compute the stepped counter but assign it (and drop the
+        # accumulation buffer) only after the jitted step succeeds: a
+        # raising step() must not lose grads or skew bias correction
+        next_step = self._step + jnp.where(
+            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
+        vecs = self._group_vectors(self.lr if lr is None else lr)
+        with self.mesh:
+            state, full, gnorm = run(
+                self._state_pack(), gin, next_step,
+                jnp.asarray(inv_scale, _f32),
+                jnp.asarray(found_inf, jnp.bool_), *vecs)
+        self._step = next_step
+        if consumed_acc:
+            self._acc = None  # buffer was donated into the jitted step
+        self._state_unpack(state)
+        self._last_grad_norm = gnorm
+        self._params = self._unflatten_groups(full)
+        return self._params
 
     # ------------------------------------------------------------- utilities
     @property
     def parameters(self):
         return self._params
 
+    @property
+    def grad_norm_last_step(self):
+        """Global grad norm computed inside the last ``step`` (pre-clip)."""
+        return self._last_grad_norm
+
     def set_parameters(self, params: Any):
         """Overwrite params AND the sharded fp32 master (e.g. after ASP
         masking) so the source-of-truth flat buffer stays consistent."""
         self._params = params
-        flat = flatten(params, self._spec, dtype=_f32, pad_to=self._n)
+        if self._single_group_input:
+            params = [params]
+        parts = [flatten(p, spec, dtype=_f32)
+                 for p, spec in zip(params, self._specs)]
+        flat = jnp.concatenate(parts)
+        if flat.size != self._n:
+            flat = jnp.pad(flat, (0, self._n - flat.size))
         if self.store_param_remainders:
             hi, lo = _split_f32(flat)
             self._master_hi = jax.device_put(hi, self._shard)
@@ -236,26 +500,43 @@ class DistributedFusedAdam:
         g, _ = multi_tensor_l2norm(grads)
         return g
 
+    def clip_grad_norm(self, grads, max_norm: float):
+        """Standalone clip (ref :2275): returns (clipped grads, norm).
+        Prefer ``max_grad_norm`` in the constructor — that fuses the clip
+        into the sharded step."""
+        norm = self.grad_norm(grads)
+        coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * coef, grads), norm
+
     def zero_grad(self, set_to_none: bool = True):
-        pass
+        self._acc = None
 
     # ---------------------------------------------------------- checkpointing
+    def _master_f32(self):
+        return (_join_f32(self._master_hi, self._master_lo)
+                if self.store_param_remainders else self._master)
+
+    def _state_f32(self):
+        if self.with_scaled_states:
+            return (_scaled_expand(self._m, self._m_scale),
+                    _scaled_expand(self._v, self._v_scale))
+        return self._m, self._v
+
     def state_dict(self, gather_on_root: bool = True):
         """v1 semantics (ref :2907): gather shards → full host arrays."""
-        master = (_join_f32(self._master_hi, self._master_lo)
-                  if self.store_param_remainders else self._master)
+        m, v = self._state_f32()
         return {
             "step": int(self._step),
             "lr": self.lr,
-            "master": np.asarray(master),
-            "m": np.asarray(self._m),
-            "v": np.asarray(self._v),
+            "master": np.asarray(self._master_f32()),
+            "m": np.asarray(m),
+            "v": np.asarray(v),
         }
 
     def sharded_state_dict(self):
         """v2 semantics (ref :3059-3329): per-shard state, no gather. Each
-        entry maps shard index → host array; pair with ``flat_spec`` metadata
-        for reload on a different world size."""
+        entry maps shard index → host array; ``unpadded`` records the true
+        payload so a different world size can re-pad on load."""
         world = self.mesh.shape[self.axis]
         shard_size = self._n // world
 
@@ -269,38 +550,68 @@ class DistributedFusedAdam:
                     out[idx] = np.asarray(s.data)
             return out
 
-        master = (_join_f32(self._master_hi, self._master_lo)
-                  if self.store_param_remainders else self._master)
+        m, v = self._state_f32()
         return {
             "step": int(self._step),
             "world": world,
             "total_size": self._n,
-            "master": shards(master),
-            "m": shards(self._m),
-            "v": shards(self._v),
+            "unpadded": self._unpadded,
+            "master": shards(self._master_f32()),
+            "m": shards(m),
+            "v": shards(v),
         }
 
     def load_state_dict(self, sd):
         self._step = jnp.asarray(sd["step"], jnp.int32)
         self.lr = sd.get("lr", self.lr)
         if "world" in sd:  # sharded (v2) checkpoint: concatenate shards
+            if "unpadded" in sd and sd["unpadded"] != self._unpadded:
+                raise ValueError(
+                    f"checkpoint payload is {sd['unpadded']} elements but "
+                    f"this optimizer's param layout is {self._unpadded} — "
+                    "the model/group structure differs from the one saved")
+
             def join(d):
                 return np.concatenate([d[i] for i in sorted(d)])
 
-            master = jnp.asarray(join(sd["master"]))
-            m = jnp.asarray(join(sd["m"]))
-            v = jnp.asarray(join(sd["v"]))
+            master = join(sd["master"])
+            m = join(sd["m"])
+            v = join(sd["v"])
         else:
-            master = jnp.asarray(sd["master"])
-            m = jnp.asarray(sd["m"])
-            v = jnp.asarray(sd["v"])
+            master = np.asarray(sd["master"])
+            m = np.asarray(sd["m"])
+            v = np.asarray(sd["v"])
+            if master.shape[0] < self._unpadded:
+                raise ValueError(
+                    f"checkpoint master has {master.shape[0]} elements, "
+                    f"fewer than this optimizer's payload {self._unpadded}")
+
+        def fit(x):
+            # world-size resharding: the unpadded payload layout is
+            # world-independent; only the zero tail padding differs
+            if x.shape[0] > self._n:
+                x = x[:self._n]
+            elif x.shape[0] < self._n:
+                x = np.pad(x, (0, self._n - x.shape[0]))
+            return jnp.asarray(x)
+
+        master, m, v = fit(master), fit(m), fit(v)
         if self.store_param_remainders:
             hi, lo = _split_f32(master)
             self._master_hi = jax.device_put(hi, self._shard)
             self._master_lo = jax.device_put(lo, self._shard)
         else:
             self._master = jax.device_put(master, self._shard)
-        self._m = jax.device_put(m, self._shard)
-        self._v = jax.device_put(v, self._shard)
-        self._params = unflatten(master, self._spec)
+        if self.with_scaled_states:
+            mv, ms = _scaled_compress(m)
+            vv, vs = _scaled_compress(v)
+            self._m = jax.device_put(mv, self._shard)
+            self._v = jax.device_put(vv, self._shard)
+            self._m_scale = jax.device_put(ms, self._shard)
+            self._v_scale = jax.device_put(vs, self._shard)
+        else:
+            self._m = jax.device_put(m.astype(self.state_dtype), self._shard)
+            self._v = jax.device_put(v.astype(self.state_dtype), self._shard)
+        self._params = self._unflatten_groups(master)
+        self._acc = None  # pending pre-restore microbatches must not leak
         self._jit_step = None

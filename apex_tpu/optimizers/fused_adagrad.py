@@ -5,21 +5,68 @@
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
 
 from apex_tpu.optimizers._base import FusedOptimizerBase, zeros_like_f32
 from apex_tpu.optimizers.functional import adagrad_update
+from apex_tpu.ops.pallas.fused_opt_kernels import fused_adagrad_flat
+from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
 
 
 class FusedAdagrad(FusedOptimizerBase):
     def __init__(self, params: Any, lr: float = 1e-2, eps: float = 1e-10,
                  weight_decay: float = 0.0, adagrad_w_mode: bool = False,
-                 set_grad_none: bool = True):
+                 set_grad_none: bool = True, use_flat: bool = True):
         super().__init__(params, lr)
         self.eps = eps
         self.weight_decay = weight_decay
         self.adagrad_w_mode = adagrad_w_mode
-        self.state = {"sum": zeros_like_f32(params)}
+        self.use_flat = use_flat
+        if use_flat:
+            self._spec = flat_spec(params)
+            self._flat_p = flatten(params, self._spec, dtype=jnp.float32,
+                                   pad_to=1024)
+            self.state = {"sum": jnp.zeros_like(self._flat_p)}
+        else:
+            self.state = {"sum": zeros_like_f32(params)}
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             inv_scale=1.0, found_inf=False):
+        if not self.use_flat:
+            return super().step(grads, lr=lr, inv_scale=inv_scale,
+                                found_inf=found_inf)
+        self._step = self._step + jnp.where(
+            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
+        flat_g = flatten(grads, self._spec, dtype=jnp.float32,
+                         pad_to=self._flat_p.size)
+        p, h = fused_adagrad_flat(
+            self._flat_p, flat_g, self.state["sum"],
+            lr=jnp.asarray(self._lr if lr is None else lr, jnp.float32),
+            eps=self.eps, weight_decay=self.weight_decay,
+            adagrad_w_mode=self.adagrad_w_mode, inv_scale=inv_scale,
+            found_inf=found_inf)
+        self._flat_p, self.state["sum"] = p, h
+        self._params = unflatten(p, self._spec)
+        return self._params
+
+    def set_parameters(self, params):
+        super().set_parameters(params)
+        if self.use_flat:
+            self._flat_p = flatten(params, self._spec, dtype=jnp.float32,
+                                   pad_to=1024)
+
+    def load_state_dict(self, sd):
+        super().load_state_dict(sd)
+        if self.use_flat:
+            self._flat_p = flatten(self._params, self._spec,
+                                   dtype=jnp.float32, pad_to=1024)
+            if not isinstance(self.state["sum"], jax.Array):
+                self.state = {"sum": flatten(self.state["sum"], self._spec,
+                                             dtype=jnp.float32,
+                                             pad_to=1024)}
 
     def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
         p, h = adagrad_update(

@@ -38,7 +38,7 @@ class FusedAdam(FusedOptimizerBase):
                  eps: float = 1e-8, adam_w_mode: bool = True,
                  weight_decay: float = 0.0, amsgrad: bool = False,
                  capturable: bool = True, master_weights: bool = False,
-                 use_flat: bool = False):
+                 use_flat: bool = True):
         if amsgrad:
             # parity with the reference: fused_adam.py:124 raises the same way
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
@@ -62,6 +62,10 @@ class FusedAdam(FusedOptimizerBase):
                 "m": jnp.zeros_like(self._flat_p, dtype=jnp.float32),
                 "v": jnp.zeros_like(self._flat_p, dtype=jnp.float32),
             }
+            if master_weights:
+                # the O2 contract: fp32 masters visible at state["master"]
+                # (here the flat buffer itself — fp32, checkpointed)
+                self.state["master"] = self._flat_p
         else:
             self.state = {
                 "m": zeros_like_f32(params),
@@ -102,8 +106,18 @@ class FusedAdam(FusedOptimizerBase):
             bias_correction=self.bias_correction, inv_scale=inv_scale,
             found_inf=found_inf)
         self._flat_p, self.state["m"], self.state["v"] = p, m, v
+        if self.master_weights:
+            self.state["master"] = self._flat_p
         self._params = unflatten(p, self._spec)
         return self._params
+
+    @property
+    def master_parameters(self):
+        """fp32 master weights (flat path: uncast views of the flat buffer;
+        tree path: the ``state['master']`` tree)."""
+        if self.use_flat and self.master_weights:
+            return unflatten(self._flat_p, self._spec, cast=False)
+        return self.state.get("master")
 
     def set_parameters(self, params):
         super().set_parameters(params)
@@ -133,6 +147,21 @@ class FusedAdam(FusedOptimizerBase):
                 self._flat_p = flatten(self._params, self._spec,
                                        dtype=self._flat_p.dtype,
                                        pad_to=1024)
+            if not isinstance(self.state["m"], jax.Array):
+                # tree-path (pre-flip default) checkpoint: repack m/v; a
+                # tree master becomes the flat fp32 buffer (keeps the O2
+                # precision the low-precision params can't reconstruct)
+                if "master" in self.state:
+                    self._flat_p = flatten(self.state["master"], self._spec,
+                                           dtype=jnp.float32, pad_to=1024)
+                self.state = {
+                    "m": flatten(self.state["m"], self._spec,
+                                 dtype=jnp.float32, pad_to=1024),
+                    "v": flatten(self.state["v"], self._spec,
+                                 dtype=jnp.float32, pad_to=1024),
+                }
+            if self.master_weights:
+                self.state["master"] = self._flat_p
 
 
 class FusedAdamW(FusedAdam):

@@ -7,10 +7,16 @@ update with per-tensor trust ratios (csrc/multi_tensor_lamb.cu stage1/stage2).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
 
 from apex_tpu.optimizers._base import FusedOptimizerBase, zeros_like_f32
 from apex_tpu.optimizers.functional import lamb_update
+from apex_tpu.ops.pallas.fused_opt_kernels import (fused_lamb_flat,
+                                                   row_segment_ids)
+from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
 
 
 class FusedLAMB(FusedOptimizerBase):
@@ -19,7 +25,7 @@ class FusedLAMB(FusedOptimizerBase):
                  eps: float = 1e-6, weight_decay: float = 0.01,
                  amsgrad: bool = False, adam_w_mode: bool = True,
                  grad_averaging: bool = True, max_grad_norm: float = 1.0,
-                 use_nvlamb: bool = False):
+                 use_nvlamb: bool = False, use_flat: bool = True):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         super().__init__(params, lr)
@@ -31,8 +37,66 @@ class FusedLAMB(FusedOptimizerBase):
         self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
-        self.state = {"m": zeros_like_f32(params), "v": zeros_like_f32(params)}
+        self.use_flat = use_flat
+        if use_flat:
+            # flat Pallas path (multi_tensor_lamb.cu stage1/stage2 analog)
+            self._spec = flat_spec(params)
+            self._flat_p = flatten(params, self._spec, dtype=jnp.float32,
+                                   pad_to=1024)
+            self._row_ids = row_segment_ids(self._spec, self._flat_p.size)
+            self.state = {
+                "m": jnp.zeros_like(self._flat_p),
+                "v": jnp.zeros_like(self._flat_p),
+            }
+        else:
+            self.state = {"m": zeros_like_f32(params),
+                          "v": zeros_like_f32(params)}
         self.last_grad_norm = None
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             inv_scale=1.0, found_inf=False):
+        if not self.use_flat:
+            return super().step(grads, lr=lr, inv_scale=inv_scale,
+                                found_inf=found_inf)
+        self._step = self._step + jnp.where(
+            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
+        flat_g = flatten(grads, self._spec, dtype=jnp.float32,
+                         pad_to=self._flat_p.size)
+        p, m, v, gnorm = fused_lamb_flat(
+            self._flat_p, flat_g, self.state["m"], self.state["v"],
+            self._row_ids, num_tensors=self._spec.num_leaves,
+            lr=jnp.asarray(self._lr if lr is None else lr, jnp.float32),
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay, step=self._step,
+            bias_correction=self.bias_correction,
+            grad_averaging=self.grad_averaging,
+            max_grad_norm=self.max_grad_norm, use_nvlamb=self.use_nvlamb,
+            adam_w_mode=self.adam_w_mode, inv_scale=inv_scale,
+            found_inf=found_inf)
+        self._flat_p, self.state["m"], self.state["v"] = p, m, v
+        self.last_grad_norm = gnorm
+        self._params = unflatten(p, self._spec)
+        return self._params
+
+    def set_parameters(self, params):
+        super().set_parameters(params)
+        if self.use_flat:
+            self._flat_p = flatten(params, self._spec, dtype=jnp.float32,
+                                   pad_to=1024)
+
+    def load_state_dict(self, sd):
+        super().load_state_dict(sd)
+        if self.use_flat:
+            self._flat_p = flatten(self._params, self._spec,
+                                   dtype=jnp.float32, pad_to=1024)
+            if not isinstance(self.state["m"], jax.Array):
+                # tree-path checkpoint: repack into the flat layout
+                self.state = {
+                    "m": flatten(self.state["m"], self._spec,
+                                 dtype=jnp.float32, pad_to=1024),
+                    "v": flatten(self.state["v"], self._spec,
+                                 dtype=jnp.float32, pad_to=1024),
+                }
 
     def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
         p, m, v, gnorm = lamb_update(

@@ -7,11 +7,17 @@ mirroring csrc/multi_tensor_novograd.cu ``NovoGradFunctor``.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
 
 from apex_tpu.optimizers._base import (FusedOptimizerBase, scalar_zeros,
                                        zeros_like_f32)
 from apex_tpu.optimizers.functional import novograd_update
+from apex_tpu.ops.pallas.fused_opt_kernels import (fused_novograd_flat,
+                                                   row_segment_ids)
+from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
 
 
 class FusedNovoGrad(FusedOptimizerBase):
@@ -20,7 +26,8 @@ class FusedNovoGrad(FusedOptimizerBase):
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  amsgrad: bool = False, reg_inside_moment: bool = False,
                  grad_averaging: bool = True, norm_type: int = 2,
-                 init_zero: bool = False, set_grad_none: bool = True):
+                 init_zero: bool = False, set_grad_none: bool = True,
+                 use_flat: Optional[bool] = None):
         if amsgrad:
             raise RuntimeError(
                 "FusedNovoGrad does not support the AMSGrad variant.")
@@ -32,8 +39,68 @@ class FusedNovoGrad(FusedOptimizerBase):
         self.grad_averaging = grad_averaging
         self.norm_type = norm_type
         self.init_zero = init_zero
-        self.state = {"m": zeros_like_f32(params),
-                      "v": scalar_zeros(params)}
+        # flat Pallas path needs the L2 norm_type (inf-norm → tree path)
+        self.use_flat = (norm_type == 2) if use_flat is None else use_flat
+        if self.use_flat and norm_type != 2:
+            raise ValueError("use_flat requires norm_type=2")
+        if self.use_flat:
+            self._spec = flat_spec(params)
+            self._flat_p = flatten(params, self._spec, dtype=jnp.float32,
+                                   pad_to=1024)
+            self._row_ids = row_segment_ids(self._spec, self._flat_p.size)
+            self.state = {
+                "m": jnp.zeros_like(self._flat_p),
+                "v": jnp.zeros((self._spec.num_leaves,), jnp.float32),
+            }
+        else:
+            self.state = {"m": zeros_like_f32(params),
+                          "v": scalar_zeros(params)}
+
+    def step(self, grads: Any, lr: Optional[float] = None,
+             inv_scale=1.0, found_inf=False):
+        if not self.use_flat:
+            return super().step(grads, lr=lr, inv_scale=inv_scale,
+                                found_inf=found_inf)
+        self._step = self._step + jnp.where(
+            jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
+        flat_g = flatten(grads, self._spec, dtype=jnp.float32,
+                         pad_to=self._flat_p.size)
+        p, m, v = fused_novograd_flat(
+            self._flat_p, flat_g, self.state["m"], self.state["v"],
+            self._row_ids, num_tensors=self._spec.num_leaves,
+            lr=jnp.asarray(self._lr if lr is None else lr, jnp.float32),
+            beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
+            weight_decay=self.weight_decay, step=self._step,
+            grad_averaging=self.grad_averaging,
+            bias_correction=self.bias_correction, norm_type=self.norm_type,
+            init_zero=self.init_zero, inv_scale=inv_scale,
+            found_inf=found_inf)
+        self._flat_p, self.state["m"], self.state["v"] = p, m, v
+        self._params = unflatten(p, self._spec)
+        return self._params
+
+    def set_parameters(self, params):
+        super().set_parameters(params)
+        if self.use_flat:
+            self._flat_p = flatten(params, self._spec, dtype=jnp.float32,
+                                   pad_to=1024)
+
+    def load_state_dict(self, sd):
+        # parity note: the reference re-materializes per-group norm tensors on
+        # load (fused_novograd.py:118); here v restores directly.
+        super().load_state_dict(sd)
+        if self.use_flat:
+            self._flat_p = flatten(self._params, self._spec,
+                                   dtype=jnp.float32, pad_to=1024)
+            if not isinstance(self.state["m"], jax.Array):
+                # tree-path checkpoint: m flat; v scalar-tree → (T,) vector
+                self.state = {
+                    "m": flatten(self.state["m"], self._spec,
+                                 dtype=jnp.float32, pad_to=1024),
+                    "v": jnp.stack([jnp.asarray(x, jnp.float32) for x in
+                                    jax.tree_util.tree_leaves(
+                                        self.state["v"])]),
+                }
 
     def _update(self, params, grads, state, step, lr, inv_scale, found_inf):
         p, m, v = novograd_update(
@@ -45,9 +112,3 @@ class FusedNovoGrad(FusedOptimizerBase):
             init_zero=self.init_zero, inv_scale=inv_scale,
             found_inf=found_inf)
         return p, {"m": m, "v": v}
-
-    def load_state_dict(self, sd):
-        # parity note: the reference re-materializes per-group norm tensors on
-        # load (fused_novograd.py:118); here v is already a per-tensor scalar
-        # tree restored directly.
-        super().load_state_dict(sd)

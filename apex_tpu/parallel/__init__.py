@@ -23,6 +23,9 @@ from apex_tpu.parallel.halo import (  # noqa: F401
 from apex_tpu.parallel.ring_attention import (  # noqa: F401
     ring_attention,
     ring_self_attention,
+    zigzag_ring_self_attention,
+    zigzag_shard,
+    zigzag_unshard,
 )
 from apex_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,

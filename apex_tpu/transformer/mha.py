@@ -40,8 +40,13 @@ class SelfMultiheadAttn(nn.Module):
     """Self-attention block ≈ fast_multihead_attn's SelfMultiheadAttn.
 
     Input (b, s, e); fused QKV projection, Pallas flash attention core
-    (causal or full), output projection. ``use_rope`` threads the fused
-    rotary embedding (csrc/megatron RoPE equivalent) into q/k.
+    (causal or full; arbitrary masks, ragged lengths and attention dropout
+    are handled inside the kernel), output projection. ``use_rope`` threads
+    the fused rotary embedding (csrc/megatron RoPE equivalent) into q/k.
+
+    ``dropout_seed`` is the train/eval switch for attention dropout: pass a
+    per-step int32 seed during training to enable ``dropout_p``; omit it
+    (eval/inference) and dropout is disabled.
     """
 
     embed_dim: int
@@ -49,10 +54,12 @@ class SelfMultiheadAttn(nn.Module):
     causal: bool = False
     use_rope: bool = False
     rope_theta: float = 10000.0
+    dropout_p: float = 0.0
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, mask: Optional[jax.Array] = None):
+    def __call__(self, x, mask: Optional[jax.Array] = None,
+                 dropout_seed: Optional[jax.Array] = None):
         b, s, e = x.shape
         h = self.num_heads
         d = e // h
@@ -75,10 +82,11 @@ class SelfMultiheadAttn(nn.Module):
                                   sin[:, None, None, :]).transpose(1, 2, 0, 3)
             k = fused_rope_cached(k.transpose(2, 0, 1, 3), cos[:, None, None, :],
                                   sin[:, None, None, :]).transpose(1, 2, 0, 3)
-        if mask is None and s % 128 == 0:
-            o = flash_attention(q, k, v, self.causal)
-        else:
-            o = mha_reference(q, k, v, self.causal, mask)
+        # always the fused Pallas path: the kernel handles arbitrary masks,
+        # ragged lengths (internal padding) and attention dropout directly
+        p = self.dropout_p if dropout_seed is not None else 0.0
+        o = flash_attention(q, k, v, self.causal, mask=mask,
+                            dropout_p=p, dropout_seed=dropout_seed)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
         return nn.Dense(e, use_bias=True, param_dtype=self.param_dtype,
                         dtype=x.dtype, name="out")(o)
@@ -89,10 +97,12 @@ class EncdecMultiheadAttn(nn.Module):
 
     embed_dim: int
     num_heads: int
+    dropout_p: float = 0.0
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, query, key_value, mask: Optional[jax.Array] = None):
+    def __call__(self, query, key_value, mask: Optional[jax.Array] = None,
+                 dropout_seed: Optional[jax.Array] = None):
         b, sq, e = query.shape
         sk = key_value.shape[1]
         h = self.num_heads
@@ -105,10 +115,9 @@ class EncdecMultiheadAttn(nn.Module):
         q = q.reshape(b, sq, h, d).transpose(0, 2, 1, 3)
         k = k.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
         v = v.reshape(b, sk, h, d).transpose(0, 2, 1, 3)
-        if mask is None and sq % 128 == 0 and sk % 128 == 0:
-            o = flash_attention(q, k, v, False)
-        else:
-            o = mha_reference(q, k, v, False, mask)
+        p = self.dropout_p if dropout_seed is not None else 0.0
+        o = flash_attention(q, k, v, False, mask=mask,
+                            dropout_p=p, dropout_seed=dropout_seed)
         o = o.transpose(0, 2, 1, 3).reshape(b, sq, e)
         return nn.Dense(e, param_dtype=self.param_dtype, dtype=query.dtype,
                         name="out")(o)

@@ -94,18 +94,22 @@ def flatten(tensors: Sequence[jax.Array] | Any, spec: FlatSpec | None = None,
     return flat
 
 
-def unflatten(flat: jax.Array, spec: FlatSpec, like: Any = None):
+def unflatten(flat: jax.Array, spec: FlatSpec, like: Any = None,
+              cast: bool = True):
     """Slice the flat buffer back into the original shapes/dtypes
     (ref csrc/flatten_unflatten.cpp:13).
 
-    Returns the original pytree structure when the spec was built from a pytree.
+    Returns the original pytree structure when the spec was built from a
+    pytree. ``cast=False`` keeps the flat buffer's dtype (e.g. fp32 master
+    views of bf16 params).
     """
     out = []
     for shape, dtype, off, _ in zip(spec.shapes, spec.dtypes, spec.offsets,
                                     spec.padded_sizes):
         n = int(np.prod(shape)) if shape else 1
         piece = jax.lax.dynamic_slice_in_dim(flat, off, n, axis=0)
-        out.append(piece.reshape(shape).astype(dtype))
+        piece = piece.reshape(shape)
+        out.append(piece.astype(dtype) if cast else piece)
     if spec.treedef is not None:
         return jax.tree_util.tree_unflatten(spec.treedef, out)
     return out

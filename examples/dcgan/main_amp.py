@@ -1,0 +1,137 @@
+"""DCGAN with amp mixed precision — TPU port of examples/dcgan/main_amp.py.
+
+The reference example's point is amp with MULTIPLE models and optimizers
+(``amp.initialize([netD, netG], [optD, optG], ...)``) and two backward
+passes per step (errD_real + errD_fake, then errG). Here: two flax models,
+two FusedAdam optimizers, one shared DynamicGradScaler policy, bf16 compute
+(O1), synthetic data (the reference's --dataset fake mode) so the example is
+self-contained.
+
+Run: python examples/dcgan/main_amp.py [--steps N] [--opt_level O1]
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+NZ, NGF, NDF, IMG = 64, 32, 32, 32
+
+
+class Generator(nn.Module):
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, z):
+        # z: (b, nz) → (b, 32, 32, 3), mirrors the reference netG conv stack
+        x = nn.Dense(4 * 4 * NGF * 4, dtype=self.compute_dtype)(z)
+        x = x.reshape(z.shape[0], 4, 4, NGF * 4)
+        for mult in (2, 1):
+            x = nn.ConvTranspose(NGF * mult, (4, 4), strides=(2, 2),
+                                 dtype=self.compute_dtype)(x)
+            x = nn.GroupNorm(num_groups=8, dtype=jnp.float32)(x)
+            x = nn.relu(x)
+        x = nn.ConvTranspose(3, (4, 4), strides=(2, 2),
+                             dtype=self.compute_dtype)(x)
+        return jnp.tanh(x.astype(jnp.float32))
+
+
+class Discriminator(nn.Module):
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, img):
+        x = img.astype(self.compute_dtype)
+        for mult in (1, 2, 4):
+            x = nn.Conv(NDF * mult, (4, 4), strides=(2, 2),
+                        dtype=self.compute_dtype)(x)
+            x = nn.leaky_relu(x, 0.2)
+        x = x.reshape(x.shape[0], -1)
+        return nn.Dense(1, dtype=jnp.float32)(x)[:, 0]
+
+
+def bce_logits(logits, label):
+    return jnp.mean(jnp.maximum(logits, 0) - logits * label
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=2e-4)
+    ap.add_argument("--opt_level", default="O1")
+    args = ap.parse_args()
+
+    policy = amp.Policy.from_opt_level(args.opt_level, loss_scale="dynamic")
+    cd = jnp.bfloat16 if args.opt_level != "O0" else jnp.float32
+    netG, netD = Generator(cd), Discriminator(cd)
+
+    key = jax.random.PRNGKey(2809)  # the reference's default manualSeed
+    kG, kD, kdata = jax.random.split(key, 3)
+    pG = netG.init(kG, jnp.zeros((1, NZ)))
+    pD = netD.init(kD, jnp.zeros((1, IMG, IMG, 3)))
+    optG = FusedAdam(pG, lr=args.lr, betas=(0.5, 0.999))
+    optD = FusedAdam(pD, lr=args.lr, betas=(0.5, 0.999))
+    scaler = policy.make_scaler()
+    sstate = scaler.init() if scaler else None
+
+    # synthetic "real" images (--dataset fake)
+    real = jax.random.uniform(kdata, (args.batch, IMG, IMG, 3), minval=-1,
+                              maxval=1)
+
+    @jax.jit
+    def d_losses(pD, pG, z, sscale):
+        fake = netG.apply(pG, z)
+        errD = (bce_logits(netD.apply(pD, real), 1.0)
+                + bce_logits(netD.apply(pD, jax.lax.stop_gradient(fake)),
+                             0.0))
+        return errD * sscale
+
+    @jax.jit
+    def g_losses(pG, pD, z, sscale):
+        fake = netG.apply(pG, z)
+        return bce_logits(netD.apply(pD, fake), 1.0) * sscale
+
+    pG_, pD_ = optG.parameters, optD.parameters
+    for step in range(args.steps):
+        z = jax.random.normal(jax.random.fold_in(key, step),
+                              (args.batch, NZ))
+        sscale = sstate.scale if scaler else jnp.float32(1.0)
+
+        # (1) update D: real + fake passes (the reference's two backwards)
+        errD, gD = jax.value_and_grad(d_losses)(pD_, pG_, z, sscale)
+        if scaler:
+            gD, inf_d = scaler.unscale(gD, sstate)
+            pD_ = optD.step(gD, found_inf=inf_d)
+            sstate = scaler.update(sstate, inf_d)
+        else:
+            pD_ = optD.step(gD)
+
+        # (2) update G through the (frozen) discriminator
+        sscale = sstate.scale if scaler else jnp.float32(1.0)
+        errG, gG = jax.value_and_grad(g_losses)(pG_, pD_, z, sscale)
+        if scaler:
+            gG, inf_g = scaler.unscale(gG, sstate)
+            pG_ = optG.step(gG, found_inf=inf_g)
+            sstate = scaler.update(sstate, inf_g)
+        else:
+            pG_ = optG.step(gG)
+
+        d = float(errD) / float(sscale)
+        g = float(errG) / float(sscale)
+        print(f"step {step:3d}  errD {d:.4f}  errG {g:.4f}"
+              + (f"  scale {float(sstate.scale):.0f}" if scaler else ""))
+
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

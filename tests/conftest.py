@@ -17,6 +17,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
+# pytest's env is already sanitized (CPU forced below), so dryrun_multichip
+# may run in-process instead of paying a cold subprocess per call.
+os.environ["_APEX_TPU_DRYRUN_INPROC"] = "1"
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -407,3 +407,88 @@ class TestGroupNormPallas:
         x = jax.random.normal(jax.random.PRNGKey(6), (1, 3, 3, 8))  # HW=9
         y = group_norm_nhwc(x, 2)
         assert bool(jnp.all(jnp.isfinite(y)))
+
+
+class TestPermutationSearch:
+    """Round-2 permutation-search parity (VERDICT item 10): the reference's
+    bounded-exhaustive + greedy-swap phases (permutation_search_kernels/
+    exhaustive_search.py, channel_swap.py) reimplemented vectorized."""
+
+    def _adversarial(self, seed=0, rows=16, cols=16):
+        """Matrix where the identity stripe grouping is provably bad: half
+        the stripes are all-large (2:4 must drop two large values each),
+        half all-small — regrouping to 2 large + 2 small per stripe keeps
+        every large value."""
+        rng = np.random.default_rng(seed)
+        m = rng.normal(size=(rows, cols)) * 0.01
+        for s in range(0, cols // 4, 2):
+            m[:, s * 4:s * 4 + 4] += rng.normal(size=(rows, 4)) * 3.0
+        return m
+
+    def test_canonical_permutation_count(self):
+        from apex_tpu.contrib.sparsity.permutation_lib import \
+            canonical_window_permutations
+        import math
+        # P = C! / ((4!)^G * G!) — the reference's analytical count
+        # (exhaustive_search.py predict_unique_combinations)
+        for c in (8, 12):
+            g = c // 4
+            want = (math.factorial(c)
+                    // (math.factorial(4) ** g * math.factorial(g)))
+            assert canonical_window_permutations(c).shape == (want, c)
+
+    def test_exhaustive_improves_adversarial(self):
+        from apex_tpu.contrib.sparsity.permutation_lib import (
+            exhaustive_search, sum_after_2_to_4)
+        m = self._adversarial()
+        base = sum_after_2_to_4(m)
+        pm, perm = exhaustive_search(m)
+        got = sum_after_2_to_4(pm)
+        assert got > base * 1.05, (base, got)
+        np.testing.assert_allclose(pm, m[:, perm])  # perm consistent
+        assert sorted(perm.tolist()) == list(range(m.shape[1]))
+
+    def test_exhaustive_matches_bruteforce_small(self):
+        """On an 8-column matrix the window IS the whole matrix: the search
+        must find the global optimum over all 35 canonical permutations."""
+        from apex_tpu.contrib.sparsity.permutation_lib import (
+            canonical_window_permutations, exhaustive_search,
+            sum_after_2_to_4)
+        rng = np.random.default_rng(3)
+        m = rng.normal(size=(8, 8))
+        best = max(sum_after_2_to_4(m[:, p])
+                   for p in canonical_window_permutations(8))
+        _, perm = exhaustive_search(m)
+        np.testing.assert_allclose(sum_after_2_to_4(m[:, perm]), best,
+                                   rtol=1e-12)
+
+    def test_greedy_improves_and_converges(self):
+        from apex_tpu.contrib.sparsity.permutation_lib import (
+            greedy_channel_swaps, sum_after_2_to_4)
+        m = self._adversarial(seed=5)
+        base = sum_after_2_to_4(m)
+        pm, perm = greedy_channel_swaps(m)
+        assert sum_after_2_to_4(pm) > base
+        # convergence: a second run from the result finds nothing
+        pm2, perm2 = greedy_channel_swaps(pm)
+        np.testing.assert_allclose(pm2, pm)
+
+    def test_entry_point_strategies(self):
+        from apex_tpu.contrib.sparsity.permutation_lib import (
+            accelerated_search_for_good_permutation, sum_after_2_to_4)
+        m = self._adversarial(seed=7)
+        base = sum_after_2_to_4(m)
+        for strat in ("exhaustive", "progressive channel swap"):
+            pm, _ = accelerated_search_for_good_permutation(
+                m, {"strategy": strat})
+            assert sum_after_2_to_4(pm) >= base
+
+    def test_asp_wrapper_preserves_function_contract(self):
+        """permuted_w == w[:, perm] (so the producer's output permutation
+        keeps the network function unchanged)."""
+        from apex_tpu.contrib.sparsity.permutation_lib import \
+            permute_channels_to_preserve_magnitude
+        w = jnp.asarray(self._adversarial(seed=9), jnp.float32)
+        pw, perm = permute_channels_to_preserve_magnitude(w)
+        np.testing.assert_allclose(np.asarray(pw),
+                                   np.asarray(w)[:, perm])

@@ -101,6 +101,143 @@ class TestDistributedFusedAdam:
             assert bool(jnp.all(jnp.isfinite(p)))
 
 
+class TestDistAdamRound2Depth:
+    """Reference-parity depth added in round 2 (VERDICT item 3):
+    param groups (:270+), integrated clip (:2275), scaled states (:2694),
+    grad accumulation, world-size-resharding checkpoints (:3059-3329)."""
+
+    def test_param_groups_per_group_hyperparams(self, mesh):
+        p_decay = _params(seed=0)
+        p_nodecay = _params(seed=1)
+        dopt = DistributedFusedAdam(
+            [{"params": p_decay, "weight_decay": 0.05},
+             {"params": p_nodecay, "weight_decay": 0.0, "lr": 3e-3,
+              "betas": (0.8, 0.95)}],
+            mesh, lr=1e-2)
+        r_decay = FusedAdam(p_decay, lr=1e-2, weight_decay=0.05)
+        r_nodecay = FusedAdam(p_nodecay, lr=3e-3, weight_decay=0.0,
+                              betas=(0.8, 0.95))
+        for s in range(1, STEPS + 1):
+            g0, g1 = _grads(s), _grads(s + 50)
+            dopt.step([g0, g1])
+            r_decay.step(g0)
+            r_nodecay.step(g1)
+        got0, got1 = dopt.parameters
+        for a, b in zip(got0, r_decay.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+        for a, b in zip(got1, r_nodecay.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_runtime_group_lr_change(self, mesh):
+        params = _params()
+        dopt = DistributedFusedAdam([{"params": params, "lr": 1e-2}], mesh)
+        ref = FusedAdam(params, lr=1e-2)
+        dopt.step([_grads(1)])
+        ref.step(_grads(1))
+        dopt.param_groups[0]["lr"] = 1e-3  # scheduler-style mutation
+        dopt.step([_grads(2)])
+        ref.step(_grads(2), lr=1e-3)
+        for a, b in zip(dopt.parameters[0], ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_integrated_clip_grad_norm(self, mesh):
+        params = _params()
+        dopt = DistributedFusedAdam(params, mesh, lr=1e-2, max_grad_norm=0.5)
+        ref = FusedAdam(params, lr=1e-2)
+        for s in range(1, 3):
+            g = _grads(s)
+            dopt.step(g)
+            # reference: clip manually then step
+            flat = jnp.concatenate([jnp.ravel(x) for x in g])
+            norm = jnp.sqrt(jnp.sum(flat * flat))
+            coef = jnp.minimum(1.0, 0.5 / (norm + 1e-6))
+            ref.step([x * coef for x in g])
+            np.testing.assert_allclose(float(dopt.grad_norm_last_step),
+                                       float(norm), rtol=1e-5)
+        for a, b in zip(dopt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_with_scaled_states(self, mesh):
+        """fp16 state + per-block scales tracks the fp32-state optimizer
+        closely (the reference's scaled-state fidelity property)."""
+        params = _params()
+        dopt = DistributedFusedAdam(params, mesh, lr=1e-2,
+                                    with_scaled_states=True)
+        ref = FusedAdam(params, lr=1e-2)
+        for s in range(1, STEPS + 1):
+            g = _grads(s)
+            dopt.step(g)
+            ref.step(g)
+        assert dopt._m.dtype == jnp.float16
+        for a, b in zip(dopt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-3)
+
+    def test_scaled_states_checkpoint_roundtrip(self, mesh):
+        params = _params()
+        d1 = DistributedFusedAdam(params, mesh, lr=1e-2,
+                                  with_scaled_states=True)
+        d1.step(_grads(1))
+        d2 = DistributedFusedAdam(_params(seed=9), mesh, lr=1e-2,
+                                  with_scaled_states=True)
+        d2.load_state_dict(d1.state_dict())
+        g = _grads(2)
+        d1.step(g)
+        d2.step(g)
+        for a, b in zip(d1.parameters, d2.parameters):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_grad_accumulation(self, mesh):
+        params = _params()
+        dopt = DistributedFusedAdam(params, mesh, lr=1e-2)
+        ref = FusedAdam(params, lr=1e-2)
+        micro = [_grads(1), _grads(2), _grads(3)]
+        for g in micro:
+            dopt.accumulate(g)
+        dopt.step()  # consumes the accumulation buffer
+        summed = [sum(gs) for gs in zip(*micro)]
+        ref.step(summed)
+        for a, b in zip(dopt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+        with pytest.raises(ValueError):
+            dopt.step()  # buffer consumed; must not silently reuse
+
+    def test_checkpoint_resharding_world8_to_world4(self):
+        """Save sharded (v2) at world=8, load at world=4 — the whole point
+        of v2 checkpoints (ref :3059-3329)."""
+        from apex_tpu.parallel import make_mesh
+        params = _params()
+        m8 = get_mesh("data")
+        d8 = DistributedFusedAdam(params, m8, lr=1e-2)
+        d8.step(_grads(1))
+        ssd = d8.sharded_state_dict()
+        assert ssd["world"] == 8
+
+        m4 = make_mesh([4], ["data"], jax.devices()[:4])
+        d4 = DistributedFusedAdam(_params(seed=7), m4, lr=1e-2)
+        d4.load_state_dict(ssd)
+        g = _grads(2)
+        d8.step(g)
+        d4.step(g)
+        for a, b in zip(d8.parameters, d4.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-7, rtol=1e-7)
+        # and back up: world=4 → world=8
+        d8b = DistributedFusedAdam(_params(seed=8), m8, lr=1e-2)
+        d8b.load_state_dict(d4.sharded_state_dict())
+        g = _grads(3)
+        d4.step(g)
+        d8b.step(g)
+        for a, b in zip(d4.parameters, d8b.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-7, rtol=1e-7)
+
+
 class TestDistributedFusedLAMB:
     def test_matches_single_device_fused_lamb(self, mesh):
         params = _params()

@@ -65,6 +65,190 @@ class TestFlashBackward:
                                        err_msg=f"d{name}")
 
 
+def _full_softmax_ref(q, k, v, causal=False, bias=None, mask=None,
+                      scale=None):
+    """Materialized-scores oracle with hard (-inf) masking; fully-masked
+    rows produce zero output (megatron generic masked softmax semantics)."""
+    import math
+    s_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * s_
+    if bias is not None:
+        s = s + bias
+    if mask is not None:
+        s = jnp.where(mask, -jnp.inf, s)
+    if causal:
+        sq, sk = s.shape[-2:]
+        cm = jnp.tril(jnp.ones((sq, sk), bool))
+        s = jnp.where(cm, s, -jnp.inf)
+    m = jnp.max(s, -1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m)
+    tot = jnp.sum(p, -1, keepdims=True)
+    p = p / jnp.where(tot > 0, tot, 1.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+class TestFlashGenerality:
+    """Round-2 kernel generality: arbitrary mask/bias, ragged lengths,
+    dropout (VERDICT item 4; reference capability
+    csrc/megatron/scaled_masked_softmax.h:211 + fast_multihead_attn)."""
+
+    @pytest.mark.parametrize("sq,sk", [(127, 127), (384, 1000), (1000, 384),
+                                       (64, 200)])
+    def test_ragged_lengths(self, sq, sk):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (2, 2, sq, D)) * 0.5
+        k = jax.random.normal(ks[1], (2, 2, sk, D)) * 0.5
+        v = jax.random.normal(ks[2], (2, 2, sk, D)) * 0.5
+        np.testing.assert_allclose(
+            np.asarray(flash_attention(q, k, v)),
+            np.asarray(_full_softmax_ref(q, k, v)), atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(flash_attention(q, k, v, True)),
+            np.asarray(_full_softmax_ref(q, k, v, causal=True)),
+            atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("sq,sk", [(256, 256), (127, 384)])
+    def test_arbitrary_mask(self, sq, sk):
+        ks = jax.random.split(jax.random.PRNGKey(1), 4)
+        q = jax.random.normal(ks[0], (2, 2, sq, D)) * 0.5
+        k = jax.random.normal(ks[1], (2, 2, sk, D)) * 0.5
+        v = jax.random.normal(ks[2], (2, 2, sk, D)) * 0.5
+        mask = jax.random.bernoulli(ks[3], 0.3, (2, 1, sq, sk))
+        mask = mask.at[:, :, 5].set(True)  # one fully-masked row
+        o = flash_attention(q, k, v, mask=mask)
+        ref = _full_softmax_ref(q, k, v, mask=mask)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        # fully-masked row → exactly zero (generic masked softmax behavior)
+        assert np.abs(np.asarray(o[:, :, 5])).max() == 0.0
+
+    def test_masked_grads(self):
+        ks = jax.random.split(jax.random.PRNGKey(2), 4)
+        q = jax.random.normal(ks[0], (1, 2, 256, D)) * 0.5
+        k = jax.random.normal(ks[1], (1, 2, 256, D)) * 0.5
+        v = jax.random.normal(ks[2], (1, 2, 256, D)) * 0.5
+        mask = jax.random.bernoulli(ks[3], 0.25, (1, 1, 256, 256))
+
+        def f(impl):
+            def inner(q, k, v):
+                return jnp.sum(impl(q, k, v) ** 2)
+            return jax.grad(inner, (0, 1, 2))(q, k, v)
+
+        gf = f(lambda q, k, v: flash_attention(q, k, v, mask=mask))
+        gr = f(lambda q, k, v: _full_softmax_ref(q, k, v, mask=mask))
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_additive_bias_grads(self):
+        """Bias is differentiable through the kernel (dbias = dlogits)."""
+        ks = jax.random.split(jax.random.PRNGKey(3), 4)
+        q = jax.random.normal(ks[0], (1, 2, 130, D)) * 0.5
+        k = jax.random.normal(ks[1], (1, 2, 130, D)) * 0.5
+        v = jax.random.normal(ks[2], (1, 2, 130, D)) * 0.5
+        bias = jax.random.normal(ks[3], (1, 1, 130, 130)) * 0.5
+
+        gf = jax.grad(lambda q, k, v, b: jnp.sum(
+            flash_attention(q, k, v, bias=b) ** 2), (0, 1, 2, 3))(
+                q, k, v, bias)
+        gr = jax.grad(lambda q, k, v, b: jnp.sum(
+            _full_softmax_ref(q, k, v, bias=b) ** 2), (0, 1, 2, 3))(
+                q, k, v, bias)
+        for a, b, name in zip(gf, gr, ["q", "k", "v", "bias"]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+    def test_per_head_bias_broadcast_grad(self):
+        ks = jax.random.split(jax.random.PRNGKey(4), 4)
+        q = jax.random.normal(ks[0], (2, 3, 128, D)) * 0.5
+        k = jax.random.normal(ks[1], (2, 3, 128, D)) * 0.5
+        v = jax.random.normal(ks[2], (2, 3, 128, D)) * 0.5
+        bias = jax.random.normal(ks[3], (1, 3, 128, 128)) * 0.5
+        gf = jax.grad(lambda b: jnp.sum(
+            flash_attention(q, k, v, bias=b) ** 2))(bias)
+        gr = jax.grad(lambda b: jnp.sum(
+            _full_softmax_ref(q, k, v, bias=b) ** 2))(bias)
+        assert gf.shape == bias.shape
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-4, rtol=5e-4)
+
+
+class TestFlashDropout:
+    def test_deterministic_and_seed_varying(self):
+        q, k, v = _qkv(seed=5)
+        o0 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=7)
+        o1 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=7)
+        o2 = flash_attention(q, k, v, dropout_p=0.3, dropout_seed=8)
+        assert np.allclose(np.asarray(o0), np.asarray(o1))
+        assert not np.allclose(np.asarray(o0), np.asarray(o2))
+
+    def test_zero_rate_matches_plain(self):
+        q, k, v = _qkv(seed=6)
+        o = flash_attention(q, k, v, dropout_p=0.0, dropout_seed=1)
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(flash_attention(q, k, v)),
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_keep_rate_statistics(self):
+        """Fraction of dropped attention entries ≈ dropout_p."""
+        q, k, v = _qkv(seed=7)
+        # v == identity-ish probe: use v = ones so output row = sum of kept
+        # normalized probs / (1-p); its mean over many rows ≈ 1
+        v1 = jnp.ones_like(v)
+        o = flash_attention(q, k, v1, dropout_p=0.25, dropout_seed=3)
+        # E[o] = 1 (each prob kept w.p. 0.75, scaled by 1/0.75)
+        assert abs(float(jnp.mean(o[..., 0])) - 1.0) < 0.05
+
+    def test_grad_matches_reference_with_same_mask(self):
+        """Autodiff through the dropout kernel == reference attention using
+        the identical regenerated keep-mask (exact, not statistical)."""
+        import math
+        ks = jax.random.split(jax.random.PRNGKey(8), 3)
+        q = jax.random.normal(ks[0], (1, 2, 256, D)) * 0.5
+        k = jax.random.normal(ks[1], (1, 2, 256, D)) * 0.5
+        v = jax.random.normal(ks[2], (1, 2, 256, D)) * 0.5
+        p_drop, seed = 0.3, 11
+
+        # regenerate the kernel's keep mask with the same hash
+        from apex_tpu.ops.pallas.flash_attention import _dropout_keep
+
+        class _Seed:
+            def __getitem__(self, _):
+                return jnp.int32(seed)
+
+        keeps = []
+        for b_ in range(2):  # b*h = 2
+            keeps.append(_dropout_keep(_Seed(), jnp.int32(b_), jnp.int32(0),
+                                       jnp.int32(0), 256, 256, p_drop))
+        keep = jnp.stack(keeps).reshape(1, 2, 256, 256)
+
+        def ref_drop(q, k, v):
+            s_ = 1.0 / math.sqrt(q.shape[-1])
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * s_
+            p = jax.nn.softmax(s, -1) * keep
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+        of = flash_attention(q, k, v, dropout_p=p_drop, dropout_seed=seed,
+                             block_q=256, block_k=256)
+        orf = ref_drop(q, k, v)
+        np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                                   atol=2e-5, rtol=2e-5)
+
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, dropout_p=p_drop, dropout_seed=seed, block_q=256,
+            block_k=256) ** 2), (0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: jnp.sum(ref_drop(q, k, v) ** 2),
+                      (0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4,
+                                       err_msg=f"d{name}")
+
+
 class TestSelfMultiheadAttn:
     def test_module_runs_and_differentiates(self):
         m = SelfMultiheadAttn(embed_dim=128, num_heads=4, causal=True,

@@ -90,3 +90,85 @@ def test_choose_mesh_shape():
     assert choose_mesh_shape(4) == (2, 2, 1)
     assert choose_mesh_shape(2) == (2, 1, 1)
     assert choose_mesh_shape(1) == (1, 1, 1)
+
+
+@pytest.mark.slow
+class TestPipelineComposed:
+    """Round-2 pp/ep composition (VERDICT item 5): the 1F1B-pipelined model
+    must match the non-pp model, and the 5-axis MoE variant must train."""
+
+    def _data(self, batch=4):
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (batch, 256), 0,
+                                    CFG.vocab_size, jnp.int32)
+        targets = jnp.roll(tokens, -1, axis=1)
+        mask = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+        return tokens, targets, mask
+
+    def test_pp_loss_and_grads_match_non_pp(self):
+        from apex_tpu.models.gpt2_parallel import (init_params_pp,
+                                                   make_train_step_pp)
+        cfg = GPT2Config(vocab_size=64, n_positions=256, n_embd=64,
+                         n_layer=2, n_head=8)
+        tokens, targets, mask = self._data()
+        key = jax.random.PRNGKey(0)
+
+        mesh_a = make_mesh([2, 2, 2], ["dp", "tp", "sp"])
+        p_a = init_params(cfg, key)
+        step_a = make_train_step(cfg, mesh_a, lr=1e-3)
+        pa, sta, loss_a = step_a(p_a, init_opt_state(p_a), tokens, targets,
+                                 mask, jnp.int32(1))
+
+        mesh_b = make_mesh([1, 2, 2, 2, 1],
+                           ["dp", "pp", "tp", "sp", "ep"])
+        p_b = init_params_pp(cfg, key)
+        step_b = make_train_step_pp(cfg, mesh_b, lr=1e-3,
+                                    num_microbatches=2)
+        pb, stb, loss_b = step_b(p_b, init_opt_state(p_b), tokens, targets,
+                                 mask, jnp.int32(1))
+
+        np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+        # Adam first-moment state == grads at step 1 (up to (1-b1) scale):
+        # the strongest cross-layout grad parity check
+        m_a = np.stack([np.asarray(b["wq"]) for b in sta[0]["blocks"]])
+        m_b = np.asarray(stb[0]["blocks"]["wq"])
+        np.testing.assert_allclose(m_a, m_b, atol=2e-5, rtol=2e-2)
+        wte_ma = np.asarray(sta[0]["wte"])
+        wte_mb = np.asarray(stb[0]["shared"]["wte"])
+        np.testing.assert_allclose(wte_ma, wte_mb, atol=2e-5, rtol=2e-2)
+
+    def test_pp_descends_multiple_steps(self):
+        from apex_tpu.models.gpt2_parallel import (init_params_pp,
+                                                   make_train_step_pp)
+        cfg = GPT2Config(vocab_size=64, n_positions=256, n_embd=64,
+                         n_layer=2, n_head=8)
+        tokens, targets, mask = self._data()
+        mesh = make_mesh([1, 2, 2, 2, 1], ["dp", "pp", "tp", "sp", "ep"])
+        p = init_params_pp(cfg, jax.random.PRNGKey(0))
+        st = init_opt_state(p)
+        step = make_train_step_pp(cfg, mesh, lr=3e-3, num_microbatches=4)
+        losses = []
+        for i in range(5):
+            p, st, loss = step(p, st, tokens, targets, mask,
+                               jnp.int32(1 + i))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_moe_5axis_trains(self):
+        from apex_tpu.models.gpt2_parallel import (init_params_pp,
+                                                   make_train_step_pp)
+        cfg = GPT2Config(vocab_size=64, n_positions=256, n_embd=64,
+                         n_layer=2, n_head=8)
+        tokens, targets, mask = self._data()
+        mesh = make_mesh([1, 2, 2, 1, 2], ["dp", "pp", "tp", "sp", "ep"])
+        p = init_params_pp(cfg, jax.random.PRNGKey(0), moe_experts=4)
+        st = init_opt_state(p)
+        step = make_train_step_pp(cfg, mesh, lr=3e-3, num_microbatches=2,
+                                  moe_experts=4)
+        losses = []
+        for i in range(5):
+            p, st, loss = step(p, st, tokens, targets, mask,
+                               jnp.int32(1 + i))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()

@@ -16,6 +16,8 @@ from apex_tpu.parallel import (HaloExchangerAllGather, HaloExchangerNoComm,
                                HaloExchangerPeer, get_mesh, halo_exchange_1d,
                                left_right_halo_exchange, make_mesh,
                                ring_self_attention)
+from apex_tpu.parallel.ring_attention import (zigzag_ring_self_attention,
+                                              zigzag_shard, zigzag_unshard)
 from apex_tpu.transformer import mha_reference
 
 WORLD = 8
@@ -126,6 +128,51 @@ class TestRingAttention:
         want = mha_reference(q, k, v, causal)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-4, rtol=2e-4)
+
+    def test_zigzag_shard_roundtrip(self):
+        x = jnp.arange(WORLD * 4.0).reshape(1, 1, WORLD * 4, 1)
+        y = zigzag_unshard(zigzag_shard(x, WORLD), WORLD)
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_zigzag_matches_single_device_reference(self, mesh):
+        """Balanced causal ring (VERDICT item 6) == full causal attention."""
+        q, k, v = self._qkv(seed=4)
+        qz, kz, vz = (zigzag_shard(t, WORLD) for t in (q, k, v))
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None), check_vma=False)
+        def ring(q, k, v):
+            return zigzag_ring_self_attention(q, k, v, "sp")
+
+        got = zigzag_unshard(ring(qz, kz, vz), WORLD)
+        want = mha_reference(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_zigzag_differentiable(self, mesh):
+        q, k, v = self._qkv(seed=5)
+        qz, kz, vz = (zigzag_shard(t, WORLD) for t in (q, k, v))
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(), check_vma=False)
+        def loss(q, k, v):
+            o = zigzag_ring_self_attention(q, k, v, "sp")
+            return jax.lax.psum(jnp.sum(o * o), "sp")
+
+        gq, gk, gv = jax.grad(loss, (0, 1, 2))(qz, kz, vz)
+
+        def ref_loss(q, k, v):
+            return jnp.sum(mha_reference(q, k, v, True) ** 2)
+
+        rq, rk, rv = jax.grad(ref_loss, (0, 1, 2))(q, k, v)
+        for g, r, name in zip((gq, gk, gv), (rq, rk, rv), "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(zigzag_unshard(g, WORLD)), np.asarray(r),
+                atol=5e-4, rtol=5e-4, err_msg=f"d{name}")
 
     def test_differentiable(self, mesh):
         q, k, v = self._qkv(seed=1)

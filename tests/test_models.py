@@ -80,6 +80,7 @@ class TestResNet:
             assert bool(jnp.all(jnp.isfinite(leaf)))
 
 
+@pytest.mark.slow
 class TestGraftEntry:
     def test_entry_compiles(self):
         import sys
