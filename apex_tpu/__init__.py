@@ -29,9 +29,5 @@ from apex_tpu import utils  # noqa: F401
 
 __version__ = "0.1.0"
 
-import warnings as _warnings
-
-
-def deprecated_warning(msg: str) -> None:
-    """Parity shim for ``apex.deprecated_warning`` (apex/__init__.py:37-43)."""
-    _warnings.warn(msg, FutureWarning, stacklevel=2)
+from apex_tpu.utils.logging import (  # noqa: F401,E402
+    deprecated_warning, one_time_warning)

@@ -13,31 +13,35 @@ import sys
 
 def _inline_bench() -> None:
     """Packaged fallback: the headline fused-Adam benchmark at wheel-install
-    scale (no repo checkout). Same metric semantics as bench.py."""
+    scale (no repo checkout). Same metric semantics and timing methodology
+    as bench.py: (rows, 128) native-tiled state (a 1-D arg would pay a
+    multi-GB relayout copy at 1B params) and fori_loop+fetch timing via
+    ``apex_tpu.utils.benchtime`` (per-dispatch wall clock is unreliable on
+    remote/async runtimes)."""
     import json
-    import time
 
     import jax
     import jax.numpy as jnp
 
-    from apex_tpu.ops.pallas.fused_adam_kernel import fused_adam_flat
+    from apex_tpu.ops.pallas.fused_adam_kernel import LANE, fused_adam_flat
+    from apex_tpu.utils.benchtime import measure_fetch_floor, timed_steps
 
     on_tpu = jax.default_backend() == "tpu"
-    n = (1_000_000_000 if on_tpu else 1_048_576) // 1024 * 1024
-    p = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.bfloat16) * 0.02
-    g = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.bfloat16)
-    m = jnp.zeros((n,), jnp.float32)
-    v = jnp.zeros((n,), jnp.float32)
-    p, m, v = fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
-                              step=jnp.int32(1), inv_scale=1.0)
-    p.block_until_ready()
-    iters = 20 if on_tpu else 2
-    t0 = time.perf_counter()
-    for i in range(iters):
-        p, m, v = fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
-                                  step=jnp.int32(2 + i), inv_scale=1.0)
-    p.block_until_ready()
-    ms = (time.perf_counter() - t0) / iters * 1e3
+    n = 999_999_488 if on_tpu else 1_048_576
+    rows = n // LANE
+    p = jax.random.normal(jax.random.PRNGKey(0), (rows, LANE),
+                          jnp.bfloat16) * 0.02
+    g = jax.random.normal(jax.random.PRNGKey(1), (rows, LANE), jnp.bfloat16)
+    m = jnp.zeros((rows, LANE), jnp.float32)
+    v = jnp.zeros((rows, LANE), jnp.float32)
+
+    def step(i, st, g):
+        p, m, v = st
+        return tuple(fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
+                                     step=i + 1, inv_scale=1.0))
+
+    ms = timed_steps(step, (p, m, v), iters=30 if on_tpu else 2,
+                     consts=(g,), floor_s=measure_fetch_floor())
     ref_ms = n * 22 / (1555e9 * 0.85) * 1e3
     print(json.dumps({
         "metric": f"fused_adam_step_ms_at_{n // 1_000_000}M_params"

@@ -1,0 +1,133 @@
+"""Deprecated-API contrib FusedAdam — TPU equivalent of
+``apex/contrib/optimizers/fused_adam.py`` (the frontend of the legacy
+``fused_adam_cuda`` extension, apex/contrib/csrc/optimizers/fused_adam_cuda.cpp:92-104).
+
+The legacy surface this preserves (used by FP16_Optimizer and
+DistributedFusedLAMB in the reference):
+
+- ``step(grads=..., output_params=..., scale=..., grad_norms=...)`` — grads
+  handed in explicitly (possibly fp16 with fp32 params = master flow), a
+  low-precision copy of the updated params written out, and a divisor
+  ``scale`` applied to grads before the update (the amp pre-unscale flow).
+- ``eps_inside_sqrt``: denom = sqrt(v_hat + eps) instead of sqrt(v_hat)+eps.
+- ``max_grad_norm``: global-norm clip folded into the combined scale, as the
+  CUDA kernel does via its ``global_grad_norm`` argument.
+
+JAX is functional, so ``step`` RETURNS ``params`` (and ``(params,
+output_params)`` when output params are requested) instead of mutating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.logging import deprecated_warning
+
+
+class FusedAdam:
+    def __init__(self, params: Any, lr: float = 1e-3,
+                 bias_correction: bool = True, betas=(0.9, 0.999),
+                 eps: float = 1e-8, eps_inside_sqrt: bool = False,
+                 weight_decay: float = 0.0, max_grad_norm: float = 0.0,
+                 amsgrad: bool = False, use_mt: bool = False,
+                 amp_scale_adjustment: float = 1.0):
+        deprecated_warning(
+            "apex_tpu.contrib.optimizers.FusedAdam is deprecated; use "
+            "apex_tpu.optimizers.FusedAdam")
+        if amsgrad:
+            raise RuntimeError("FusedAdam does not support the AMSGrad "
+                               "variant.")
+        self.parameters = params
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.eps_mode = 0 if eps_inside_sqrt else 1
+        self.weight_decay = weight_decay
+        self.max_grad_norm = max_grad_norm
+        self._amp_scale_adjustment = amp_scale_adjustment
+        self._step = 0
+        f32 = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
+        self.exp_avg = jax.tree_util.tree_map(f32, params)
+        self.exp_avg_sq = jax.tree_util.tree_map(f32, params)
+
+    def step(self, closure=None, grads: Any = None,
+             output_params: Any = None, scale: float = 1.0,
+             grad_norms=None, lr: Optional[float] = None):
+        """Legacy step. ``grads`` may be lower precision than params (master
+        flow); ``scale`` divides grads first; returns updated params, or
+        ``(params, output_params)`` when ``output_params`` is not None
+        (a pytree/list matching params whose dtype is reused for the
+        low-precision copy-out)."""
+        loss = closure() if closure is not None else None
+        if grads is None:
+            raise ValueError("the deprecated flow passes grads explicitly")
+        self._step += 1
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+
+        combined = float(scale) * self._amp_scale_adjustment
+        if self.max_grad_norm > 0 and grad_norms is not None:
+            gnorm = jnp.asarray(grad_norms, jnp.float32)
+            if gnorm.ndim > 0:
+                gnorm = jnp.sqrt(jnp.sum(gnorm ** 2))
+            clip = gnorm / (combined * self.max_grad_norm)
+            combined = combined * jnp.maximum(clip, 1.0)
+
+        # legacy kernel folds bias correction into step_size and keeps v raw
+        # (fused_adam_cuda_kernel.cu:182-189)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** self._step
+            bc2 = 1.0 - b2 ** self._step
+            step_size = lr * (bc2 ** 0.5) / bc1
+        else:
+            step_size = lr
+
+        eps, wd, eps_mode = self.eps, self.weight_decay, self.eps_mode
+
+        def upd(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) / combined
+            m_new = b1 * m + (1.0 - b1) * g32
+            v_new = b2 * v + (1.0 - b2) * g32 * g32
+            if eps_mode == 0:
+                denom = jnp.sqrt(v_new + eps)
+            else:
+                denom = jnp.sqrt(v_new) + eps
+            # decay joins the UPDATE term, after the moments
+            # (fused_adam_cuda_kernel.cu:58)
+            update = m_new / denom + wd * p32
+            p32 = p32 - step_size * update
+            return p32.astype(p.dtype), m_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, self.parameters, grads,
+                                      self.exp_avg, self.exp_avg_sq)
+        self.parameters = jax.tree_util.tree_map(
+            lambda t: t[0], flat, is_leaf=lambda x: isinstance(x, tuple))
+        self.exp_avg = jax.tree_util.tree_map(
+            lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
+        self.exp_avg_sq = jax.tree_util.tree_map(
+            lambda t: t[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+
+        if output_params is not None:
+            out = jax.tree_util.tree_map(
+                lambda p, o: p.astype(o.dtype), self.parameters,
+                output_params)
+            if loss is not None:
+                return loss, self.parameters, out
+            return self.parameters, out
+        if loss is not None:
+            return loss, self.parameters
+        return self.parameters
+
+    def state_dict(self):
+        return {"step": self._step, "exp_avg": self.exp_avg,
+                "exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd):
+        self._step = int(sd["step"])
+        self.exp_avg = sd["exp_avg"]
+        self.exp_avg_sq = sd["exp_avg_sq"]

@@ -1,0 +1,94 @@
+"""Deprecated-API contrib FusedSGD — TPU equivalent of
+``apex/contrib/optimizers/fused_sgd.py`` (frontend of the legacy
+``fused_adam_cuda``/SGD extensions; step signature :129).
+
+Preserves the legacy explicit-grads flow: ``step(grads=...,
+output_params=..., scale=...)`` with momentum / dampening / nesterov /
+``wd_after_momentum``. Functional: returns updated params (and the
+low-precision copies when requested) instead of mutating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.logging import deprecated_warning
+
+
+class FusedSGD:
+    def __init__(self, params: Any, lr: float, momentum: float = 0.0,
+                 dampening: float = 0.0, weight_decay: float = 0.0,
+                 nesterov: bool = False, wd_after_momentum: bool = False,
+                 materialize_master_grads: bool = True):
+        deprecated_warning(
+            "apex_tpu.contrib.optimizers.FusedSGD is deprecated; use "
+            "apex_tpu.optimizers.FusedSGD")
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        self.parameters = params
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+        self._first = True
+        self.momentum_buffer = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def step(self, closure=None, grads: Any = None,
+             output_params: Any = None, scale: float = 1.0,
+             grad_norms=None, lr: Optional[float] = None):
+        loss = closure() if closure is not None else None
+        if grads is None:
+            raise ValueError("the deprecated flow passes grads explicitly")
+        lr = self.lr if lr is None else lr
+        mom, damp, wd = self.momentum, self.dampening, self.weight_decay
+        nesterov, wd_after = self.nesterov, self.wd_after_momentum
+        first = self._first
+        self._first = False
+        inv = 1.0 / float(scale)
+
+        def upd(p, g, buf):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * inv
+            if wd and not wd_after:
+                g32 = g32 + wd * p32
+            if mom:
+                buf = g32 if first else mom * buf + (1.0 - damp) * g32
+                g32 = g32 + mom * buf if nesterov else buf
+            if wd and wd_after:
+                g32 = g32 + wd * p32
+            p32 = p32 - lr * g32
+            return p32.astype(p.dtype), buf
+
+        flat = jax.tree_util.tree_map(upd, self.parameters, grads,
+                                      self.momentum_buffer)
+        is_t = lambda x: isinstance(x, tuple)  # noqa: E731
+        self.parameters = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                                 is_leaf=is_t)
+        self.momentum_buffer = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                                      is_leaf=is_t)
+
+        if output_params is not None:
+            out = jax.tree_util.tree_map(
+                lambda p, o: p.astype(o.dtype), self.parameters,
+                output_params)
+            if loss is not None:
+                return loss, self.parameters, out
+            return self.parameters, out
+        if loss is not None:
+            return loss, self.parameters
+        return self.parameters
+
+    def state_dict(self):
+        return {"momentum_buffer": self.momentum_buffer,
+                "first": self._first}
+
+    def load_state_dict(self, sd):
+        self.momentum_buffer = sd["momentum_buffer"]
+        self._first = bool(sd["first"])

@@ -224,4 +224,7 @@ def permute_channels_to_preserve_magnitude(
         return w, np.arange(cols)
     _, perm = accelerated_search_for_good_permutation(
         arr2.astype(np.float64), {"strategy": strategy})
-    return jnp.asarray(arr2[:, perm]), perm
+    # both exit paths return the INPUT's rank and dtype (w[:, perm] shape
+    # semantics) — the float64 working copy stays internal to the search
+    permuted = arr2[:, perm].reshape(w_np.shape)
+    return jnp.asarray(permuted, dtype=w.dtype), perm

@@ -388,9 +388,14 @@ def make_train_step_pp(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
         def stage_fn(stage_blocks, shared_, x_act, tok, tgt, msk):
             my_pp = jax.lax.axis_index("pp")
             last = my_pp == jax.lax.axis_size("pp") - 1
-            x0 = (shared_["wte"][tok].astype(cd)
-                  + shared_["wpe"][None].astype(cd))
-            x = jnp.where(my_pp == 0, x0, x_act)
+            # cond (not where): only stage 0 pays the (vocab, e) embedding
+            # gather — and its scatter-add cotangent — per tick; mirrors the
+            # lax.cond gating of the vocab-logits loss on the last stage
+            x = jax.lax.cond(
+                my_pp == 0,
+                lambda: (shared_["wte"][tok].astype(cd)
+                         + shared_["wpe"][None].astype(cd)),
+                lambda: x_act)
             lps = cfg.n_layer // pp
             for i in range(lps):
                 blk = jax.tree_util.tree_map(lambda l: l[i], stage_blocks)

@@ -115,11 +115,12 @@ def _as_rows(x: jax.Array):
 
 
 def _pick_block_rows(rows: int) -> int:
-    # rows is a multiple of 8 by construction; block rows must stay one too
-    br = DEFAULT_BLOCK_ROWS
-    while rows % br != 0 and br > SUBLANE:
-        br //= 2
-    return max(br, SUBLANE)
+    """Fixed streaming block; the grid is ``pl.cdiv(rows, br)`` and Mosaic
+    masks the ragged tail block (safe: every kernel using this is elementwise
+    per row, so out-of-bounds garbage reads never feed an in-bounds write).
+    A divisor search here is a perf trap — at 999M elements the largest
+    divisor ≤512 of rows is 16, which once produced a 488k-step grid."""
+    return min(DEFAULT_BLOCK_ROWS, rows)
 
 
 def _pack_scalars(lr, beta1, beta2, eps, weight_decay, step,
@@ -166,7 +167,7 @@ def fused_adam_flat(p: jax.Array, g: jax.Array, m: jax.Array, v: jax.Array,
     # interpret mode executes the grid cell-by-cell in Python — use a
     # single block so CPU tests pay one kernel invocation, not hundreds
     br = block_rows or (rows if interpret else _pick_block_rows(rows))
-    grid = (rows // br,)
+    grid = (pl.cdiv(rows, br),)
 
     def dspec():
         return pl.BlockSpec((br, LANE), lambda i: (i, 0),
@@ -219,7 +220,7 @@ def fused_adam_flat_master(p_master: jax.Array, g: jax.Array, m: jax.Array,
     # interpret mode executes the grid cell-by-cell in Python — use a
     # single block so CPU tests pay one kernel invocation, not hundreds
     br = block_rows or (rows if interpret else _pick_block_rows(rows))
-    grid = (rows // br,)
+    grid = (pl.cdiv(rows, br),)
 
     def dspec():
         return pl.BlockSpec((br, LANE), lambda i: (i, 0),

@@ -165,7 +165,7 @@ def fused_lamb_flat(p, g, m, v, row_ids, *, num_tensors: int, lr,
     # interpret mode executes the grid cell-by-cell in Python — use a
     # single block so CPU tests pay one kernel invocation, not hundreds
     br = block_rows or (rows if interpret else _pick_block_rows(rows))
-    grid = (rows // br,)
+    grid = (pl.cdiv(rows, br),)
 
     u2, m_new, v_new = pl.pallas_call(
         functools.partial(_lamb_stage1_kernel, adam_w=adam_w_mode),
@@ -289,7 +289,7 @@ def fused_novograd_flat(p, g, m, v_per_tensor, row_ids, *, num_tensors: int,
     # interpret mode executes the grid cell-by-cell in Python — use a
     # single block so CPU tests pay one kernel invocation, not hundreds
     br = block_rows or (rows if interpret else _pick_block_rows(rows))
-    grid = (rows // br,)
+    grid = (pl.cdiv(rows, br),)
 
     p_new, m_new = pl.pallas_call(
         _novograd_kernel,
@@ -355,7 +355,7 @@ def fused_adagrad_flat(p, g, h, *, lr, eps: float = 1e-10,
     # interpret mode executes the grid cell-by-cell in Python — use a
     # single block so CPU tests pay one kernel invocation, not hundreds
     br = block_rows or (rows if interpret else _pick_block_rows(rows))
-    grid = (rows // br,)
+    grid = (pl.cdiv(rows, br),)
 
     p_new, h_new = pl.pallas_call(
         functools.partial(_adagrad_kernel, adagrad_w=adagrad_w_mode),

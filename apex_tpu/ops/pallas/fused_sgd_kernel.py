@@ -83,7 +83,7 @@ def fused_sgd_flat(p: jax.Array, g: jax.Array, momentum_buf: jax.Array,
     # interpret mode executes the grid cell-by-cell in Python — use a
     # single block so CPU tests pay one kernel invocation, not hundreds
     br = block_rows or (rows if interpret else _pick_block_rows(rows))
-    grid = (rows // br,)
+    grid = (pl.cdiv(rows, br),)
 
     def dspec():
         return pl.BlockSpec((br, LANE), lambda i: (i, 0),

@@ -2,3 +2,7 @@ from apex_tpu.utils.flatten import flatten, unflatten, FlatSpec, flat_spec  # no
 from apex_tpu.utils.env import interpret_default, platform_is_tpu  # noqa: F401
 from apex_tpu.utils import checkpoint  # noqa: F401
 from apex_tpu.utils import prof  # noqa: F401
+from apex_tpu.utils import logging  # noqa: F401
+from apex_tpu.utils.logging import (  # noqa: F401
+    AverageMeter, MetricLogger, deprecated_warning, one_time_warning)
+from apex_tpu.utils import benchtime  # noqa: F401

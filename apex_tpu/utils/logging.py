@@ -1,0 +1,118 @@
+"""Metrics / logging / observability — SURVEY §5 "metrics/logging".
+
+The reference has no first-class subsystem: ``apex.deprecated_warning``
+(apex/__init__.py:37-43), the print-once pattern of ``one_time_warning``
+(apex/contrib/group_norm/group_norm.py:22), and per-example AverageMeters
+(examples/imagenet/main_amp.py). The TPU framework makes these first-class:
+
+- ``deprecated_warning`` / ``one_time_warning`` — exact-capability ports.
+- ``AverageMeter`` — the examples' running-average pattern.
+- ``MetricLogger`` — structured per-step metric logging (console and/or
+  JSONL), with device-array coercion deferred to flush time so logging never
+  forces a mid-step host sync (the TPU analog of "don't .item() in the hot
+  loop").
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import warnings
+from typing import Any, Dict, Optional
+
+_seen_warnings: set = set()
+
+
+def deprecated_warning(msg: str) -> None:
+    """apex.deprecated_warning parity (apex/__init__.py:37-43): emit once per
+    distinct message. FutureWarning, as in the reference's
+    DeprecatedFeatureWarning(FutureWarning) — unlike DeprecationWarning it is
+    shown under default filters, so users actually see it."""
+    if msg in _seen_warnings:
+        return
+    _seen_warnings.add(msg)
+    warnings.warn(msg, FutureWarning, stacklevel=2)
+
+
+def one_time_warning(msg: str) -> None:
+    """group_norm.py:22 parity: print a warning once per distinct message."""
+    if msg in _seen_warnings:
+        return
+    _seen_warnings.add(msg)
+    print(f"Warning: {msg}", file=sys.stderr)
+
+
+class AverageMeter:
+    """Running average (examples/imagenet/main_amp.py AverageMeter)."""
+
+    def __init__(self, name: str = "", fmt: str = ":f"):
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self):
+        self.val = 0.0
+        self.sum = 0.0
+        self.count = 0
+        self.avg = 0.0
+
+    def update(self, val, n: int = 1):
+        val = float(val)
+        self.val = val
+        self.sum += val * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self):
+        return ("{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+                ).format(name=self.name, val=self.val, avg=self.avg)
+
+
+class MetricLogger:
+    """Structured step metrics with deferred host sync.
+
+    ``log(step, **metrics)`` buffers metric values (device arrays stay
+    device arrays); ``flush()`` coerces to floats (ONE host sync for the
+    whole buffer), updates running meters, and writes console/JSONL output.
+    """
+
+    def __init__(self, jsonl_path: Optional[str] = None,
+                 print_every: int = 0, stream=None):
+        self.jsonl_path = jsonl_path
+        self.print_every = print_every
+        self.stream = stream or sys.stderr
+        self.meters: Dict[str, AverageMeter] = {}
+        self._buffer: list = []
+        self._t0 = time.time()
+
+    def log(self, step: int, **metrics: Any) -> None:
+        self._buffer.append((step, time.time() - self._t0, metrics))
+        if self.print_every and step % self.print_every == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._buffer:
+            return
+        rows = []
+        for step, t, metrics in self._buffer:
+            row = {"step": step, "t": round(t, 3)}
+            for k, val in metrics.items():
+                v = float(val)
+                row[k] = v
+                self.meters.setdefault(k, AverageMeter(k, ":.4f")).update(v)
+            rows.append(row)
+        self._buffer.clear()
+        if self.jsonl_path:
+            with open(self.jsonl_path, "a") as f:
+                for row in rows:
+                    f.write(json.dumps(row) + "\n")
+        if self.print_every:
+            last = rows[-1]
+            parts = [f"step {last['step']}"] + [
+                str(m) for k, m in sorted(self.meters.items())]
+            print("  ".join(parts), file=self.stream)
+
+    def summary(self) -> Dict[str, float]:
+        self.flush()
+        return {k: m.avg for k, m in self.meters.items()}

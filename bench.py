@@ -9,17 +9,27 @@ via csrc/multi_tensor_apply.cuh:32-103). Dtype mix matches the reference's
 mixed-precision setup: bf16 params + bf16 grads + fp32 exp_avg/exp_avg_sq
 (fused_adam.py:212-232 groups). The op is HBM-bound: 22 bytes/element.
 
-Suite (BASELINE.md configs 2-5 coverage, VERDICT item 2):
+Timing methodology: K chained steps inside ONE jitted ``lax.fori_loop`` with
+donated state, completion forced by a host fetch of one output element
+(apex_tpu/utils/benchtime.py). Wall-clock around individual dispatches is
+meaningless on the tunneled runtime — ``block_until_ready`` returns before
+remote execution completes — and the loop form is also the honest analog of
+the reference's CUDA-graph "capturable" mode (one launch, K steps).
+
+Suite (BASELINE.md configs 2-5 coverage):
 - ``fused_adam_1b``: the headline.
-- ``layer_norm``: Pallas LN fwd+bwd (csrc/layer_norm_cuda_kernel.cu path).
-- ``flash_attention``: causal flash fwd+bwd (megatron softmax + MHA path).
+- ``layer_norm``: Pallas LN fwd/bwd (csrc/layer_norm_cuda_kernel.cu path).
+- ``flash_attention``: causal flash fwd/bwd (megatron softmax + MHA path).
 - ``resnet50_train``: one jitted ResNet-50 train step (fwd+bwd+FusedAdam),
   imgs/sec/chip — the north-star recipe of tests/L1 (main_amp.py).
 
-``vs_baseline``: measured A100-class estimate for the same op (HBM-bandwidth
-model at 1555 GB/s · 85% achievable for memory-bound ops; published MLPerf
-A100 throughput for ResNet-50). >1 ⇒ faster than the A100 reference path.
-``hbm_frac`` (suite): fraction of this chip's HBM peak the op achieved.
+``vs_baseline``: measured-time ratio vs an A100-class estimate for the same
+op (HBM-bandwidth model at 1555 GB/s · 85% achievable for memory-bound ops;
+published MLPerf A100 throughput for ResNet-50). >1 ⇒ faster than the A100
+reference path. NOTE: a v5e has 819 GB/s HBM vs an A100's 1555 — for
+HBM-bound ops the chip-fair comparison is ``hbm_frac`` (fraction of this
+chip's peak achieved) vs the reference kernels' ~85%-of-A100-peak; and
+``efficiency_vs_ref`` = hbm_frac / 0.85 reports exactly that ratio.
 
 On non-TPU hosts (CI smoke) tiny shapes keep interpret-mode runtime sane; the
 driver runs this on the real chip.
@@ -76,99 +86,108 @@ def _backend_with_timeout(seconds: int = 180):
     return jax, jax.default_backend()
 
 
-def _timed(fn, *args, iters=20, warmup=2):
-    import jax
+def bench_fused_adam(jax, jnp, on_tpu, chip, floor_s):
+    from apex_tpu.ops.pallas.fused_adam_kernel import LANE, fused_adam_flat
+    from apex_tpu.utils.benchtime import timed_steps
 
-    out = None
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1e3  # ms
+    n = (999_999_488 if on_tpu else 1_048_576)
+    rows = n // LANE
+    # state lives as (rows, 128) — the kernel's native tiling — so no
+    # relayout copy sits between steps (a 1-D->2-D copy of fp32 state is
+    # 7.4 GB and OOMs the 1B case)
+    p = jax.random.normal(jax.random.PRNGKey(0), (rows, LANE),
+                          jnp.bfloat16) * 0.02
+    g = jax.random.normal(jax.random.PRNGKey(1), (rows, LANE), jnp.bfloat16)
+    m = jnp.zeros((rows, LANE), jnp.float32)
+    v = jnp.zeros((rows, LANE), jnp.float32)
 
+    def step(i, st, g):
+        p, m, v = st
+        p, m, v = fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
+                                  step=i + 1, inv_scale=1.0)
+        return (p, m, v)
 
-def bench_fused_adam(jax, jnp, on_tpu, chip):
-    n = (1_000_000_000 if on_tpu else 1_048_576) // 1024 * 1024
-    from apex_tpu.ops.pallas.fused_adam_kernel import fused_adam_flat
-
-    p = jax.random.normal(jax.random.PRNGKey(0), (n,), jnp.bfloat16) * 0.02
-    g = jax.random.normal(jax.random.PRNGKey(1), (n,), jnp.bfloat16)
-    m = jnp.zeros((n,), jnp.float32)
-    v = jnp.zeros((n,), jnp.float32)
-
-    state = [p, m, v]
-
-    def step(s):
-        return fused_adam_flat(state[0], g, state[1], state[2], lr=1e-3,
-                               weight_decay=0.01, step=s, inv_scale=1.0)
-
-    # warmup / compile (donation: rebind buffers each call)
-    state = list(step(jnp.int32(1)))
-    jax.block_until_ready(state[0])
-    iters = 20 if on_tpu else 2
-    t0 = time.perf_counter()
-    for i in range(iters):
-        state = list(step(jnp.int32(2 + i)))
-    jax.block_until_ready(state[0])
-    ms = (time.perf_counter() - t0) / iters * 1e3
-
+    ms = timed_steps(step, (p, m, v), iters=30 if on_tpu else 2,
+                     consts=(g,), floor_s=floor_s)
     bytes_moved = n * 22  # r: p2+g2+m4+v4, w: p2+m4+v4
     ref_ms = bytes_moved / _A100_GBPS * 1e3
+    hbm_frac = bytes_moved / (ms / 1e3) / 1e9 / chip["hbm_gbps"]
     return {
         "metric": f"fused_adam_step_ms_at_{n // 1_000_000}M_params_"
                   f"bf16p_f32state",
         "value": round(ms, 3),
         "unit": "ms",
         "vs_baseline": round(ref_ms / ms, 3),
-        "hbm_frac": round(bytes_moved / (ms / 1e3) / 1e9
-                          / chip["hbm_gbps"], 3),
+        "hbm_frac": round(hbm_frac, 3),
+        "efficiency_vs_ref": round(hbm_frac / 0.85, 3),
     }
 
 
-def bench_layer_norm(jax, jnp, on_tpu, chip):
+def bench_layer_norm(jax, jnp, on_tpu, chip, floor_s):
     rows, cols = (8192, 4096) if on_tpu else (256, 512)
     from apex_tpu.normalization.fused_layer_norm import \
         fused_layer_norm_affine
+    from apex_tpu.utils.benchtime import timed_steps
 
     x = jax.random.normal(jax.random.PRNGKey(0), (rows, cols), jnp.bfloat16)
     w = jnp.ones((cols,), jnp.float32)
     b = jnp.zeros((cols,), jnp.float32)
+    iters = 50 if on_tpu else 2
 
-    fwd = jax.jit(lambda x: fused_layer_norm_affine(x, w, b, cols))
-    ms_fwd = _timed(fwd, x, iters=20 if on_tpu else 2)
+    def fwd_step(i, x, w, b):
+        # LN output is normalized, so chaining is numerically stable
+        return fused_layer_norm_affine(x, w, b, cols).astype(x.dtype)
 
-    grad = jax.jit(jax.grad(
-        lambda x: jnp.sum(fused_layer_norm_affine(x, w, b, cols) ** 2)))
-    ms_bwd = _timed(grad, x, iters=20 if on_tpu else 2)
+    ms_fwd = timed_steps(fwd_step, x, iters=iters, consts=(w, b),
+                         floor_s=floor_s, donate=False)
+
+    gradfn = jax.grad(
+        lambda x, w, b: jnp.sum(fused_layer_norm_affine(x, w, b, cols)
+                                .astype(jnp.float32) ** 2))
+
+    def bwd_step(i, x, w, b):
+        return (x + 1e-6 * gradfn(x, w, b).astype(x.dtype)).astype(x.dtype)
+
+    ms_fb = timed_steps(bwd_step, x, iters=iters, consts=(w, b),
+                        floor_s=floor_s, donate=False)
 
     n = rows * cols
     ref_fwd = (n * 4) / _A100_GBPS * 1e3  # r2 + w2 bytes
+    hbm_frac = (n * 4) / (ms_fwd / 1e3) / 1e9 / chip["hbm_gbps"]
     return {
         "metric": f"layer_norm_fwd_ms_{rows}x{cols}_bf16",
         "value": round(ms_fwd, 3), "unit": "ms",
-        "bwd_ms": round(ms_bwd, 3),
+        "fwd_bwd_ms": round(ms_fb, 3),
         "vs_baseline": round(ref_fwd / ms_fwd, 3),
-        "hbm_frac": round((n * 4) / (ms_fwd / 1e3) / 1e9
-                          / chip["hbm_gbps"], 3),
+        "hbm_frac": round(hbm_frac, 3),
+        "efficiency_vs_ref": round(hbm_frac / 0.85, 3),
     }
 
 
-def bench_flash_attention(jax, jnp, on_tpu, chip):
+def bench_flash_attention(jax, jnp, on_tpu, chip, floor_s):
     b, h, s, d = (4, 16, 2048, 64) if on_tpu else (1, 2, 256, 64)
     from apex_tpu.ops.pallas.flash_attention import flash_attention
+    from apex_tpu.utils.benchtime import timed_steps
 
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(k_, (b, h, s, d), jnp.bfloat16) * 0.2
                for k_ in ks)
-    fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))
-    ms_fwd = _timed(fwd, q, k, v, iters=10 if on_tpu else 2)
-    grad = jax.jit(jax.grad(
-        lambda q, k, v: jnp.sum(flash_attention(q, k, v, True)
-                                .astype(jnp.float32) ** 2), (0, 1, 2)))
-    ms_bwd = _timed(grad, q, k, v, iters=10 if on_tpu else 2)
+    iters = 20 if on_tpu else 2
+
+    def fwd_step(i, q, k, v):
+        return flash_attention(q, k, v, True).astype(q.dtype)
+
+    ms_fwd = timed_steps(fwd_step, q, iters=iters, consts=(k, v),
+                         floor_s=floor_s, donate=False)
+
+    gradfn = jax.grad(lambda q, k, v: jnp.sum(
+        flash_attention(q, k, v, True).astype(jnp.float32) ** 2))
+
+    def bwd_step(i, q, k, v):
+        return (q + 1e-3 * gradfn(q, k, v).astype(q.dtype)).astype(q.dtype)
+
+    ms_fb = timed_steps(bwd_step, q, iters=iters, consts=(k, v),
+                        floor_s=floor_s, donate=False)
 
     # causal: 2 matmuls over s²/2 valid positions
     flops = 2 * 2 * b * h * s * s * d / 2
@@ -178,18 +197,63 @@ def bench_flash_attention(jax, jnp, on_tpu, chip):
     return {
         "metric": f"flash_attention_causal_fwd_ms_b{b}h{h}s{s}d{d}",
         "value": round(ms_fwd, 3), "unit": "ms",
-        "bwd_ms": round(ms_bwd, 3),
+        "fwd_bwd_ms": round(ms_fb, 3),
         "vs_baseline": round(ref_ms / ms_fwd, 3),
         "tflops": round(tflops, 1),
         "mxu_frac": round(tflops / chip["tflops"], 3),
     }
 
 
-def bench_resnet50(jax, jnp, on_tpu, chip):
-    import numpy as np
+def bench_softmax_rope(jax, jnp, on_tpu, chip, floor_s):
+    """Microbench for the megatron-kernel equivalents (VERDICT weak 7):
+    scaled_upper_triang_masked_softmax and fused RoPE (sbhd). These are
+    jnp+custom-VJP designs whose claim is that XLA fusion matches the
+    reference's warp kernels — this measures that claim."""
+    from apex_tpu.transformer.rope import fused_rope
+    from apex_tpu.transformer.softmax import \
+        scaled_upper_triang_masked_softmax
+    from apex_tpu.utils.benchtime import timed_steps
 
+    b, h, s, d = (8, 16, 1024, 64) if on_tpu else (1, 2, 128, 32)
+    iters = 50 if on_tpu else 2
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, h, s, s),
+                          jnp.bfloat16) * 0.1
+
+    def sm_step(i, x):
+        y = scaled_upper_triang_masked_softmax(x, 0.5)
+        # keep the carry distribution stable: renorm to ~unit entries
+        return (y * s).astype(x.dtype) * 0.1
+
+    ms_sm = timed_steps(sm_step, x, iters=iters, floor_s=floor_s)
+    sm_bytes = x.size * 2 * 2  # read + write bf16
+
+    t = jax.random.normal(jax.random.PRNGKey(1), (s, b, h, d), jnp.bfloat16)
+
+    freqs = (jnp.arange(s, dtype=jnp.float32)[:, None]
+             * jnp.exp(-jnp.arange(d // 2, dtype=jnp.float32) / d))
+    freqs = jnp.concatenate([freqs, freqs], axis=-1)  # (s, d)
+
+    def rope_step(i, t):
+        return fused_rope(t, freqs).astype(t.dtype)
+
+    ms_rope = timed_steps(rope_step, t, iters=iters, floor_s=floor_s)
+    rope_bytes = t.size * 2 * 2
+    return {
+        "metric": f"softmax_causal_fwd_ms_b{b}h{h}s{s}",
+        "value": round(ms_sm, 3), "unit": "ms",
+        "hbm_frac": round(sm_bytes / (ms_sm / 1e3) / 1e9
+                          / chip["hbm_gbps"], 3),
+        "rope_sbhd_ms": round(ms_rope, 3),
+        "rope_hbm_frac": round(rope_bytes / (ms_rope / 1e3) / 1e9
+                               / chip["hbm_gbps"], 3),
+        "vs_baseline": round(((sm_bytes / _A100_GBPS * 1e3) / ms_sm), 3),
+    }
+
+
+def bench_resnet50(jax, jnp, on_tpu, chip, floor_s):
     from apex_tpu.models.resnet import ResNet18ish, ResNet50
     from apex_tpu.optimizers.functional import adam_update
+    from apex_tpu.utils.benchtime import timed_steps
 
     if on_tpu:
         model, batch, hw = ResNet50(), 128, 224
@@ -206,8 +270,9 @@ def bench_resnet50(jax, jnp, on_tpu, chip):
     v0 = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32),
                                 params)
 
-    @jax.jit
-    def train_step(params, m, v, bstats, x, y, step):
+    def train_step(i, state, x, y):
+        params, m, v, bstats = state
+
         def loss_fn(p):
             logits, updated = model.apply(
                 {"params": p, "batch_stats": bstats}, x,
@@ -219,27 +284,13 @@ def bench_resnet50(jax, jnp, on_tpu, chip):
 
         (loss, bs2), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
-        params, m, v = adam_update(params, grads, m, v, step=step,
+        params, m, v = adam_update(params, grads, m, v, step=i + 1,
                                    lr=1e-3, weight_decay=1e-4)
-        return params, m, v, bs2, loss
+        return (params, m, v, bs2)
 
-    def step_wrap(params, m, v, x, y, s):
-        nonlocal bstats
-        params, m, v, bstats, loss = train_step(params, m, v, bstats, x,
-                                                y, s)
-        return params, m, v, loss
-
-    train_step_run = step_wrap
-    state = (params, m0, v0)
-    state = train_step_run(*state, x, y, jnp.int32(1))[:3]
-    jax.block_until_ready(state[0])
     iters = 10 if on_tpu else 2
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out = train_step_run(*state, x, y, jnp.int32(2 + i))
-        state = out[:3]
-    jax.block_until_ready(state[0])
-    ms = (time.perf_counter() - t0) / iters * 1e3
+    ms = timed_steps(train_step, (params, m0, v0, bstats), iters=iters,
+                     consts=(x, y), floor_s=floor_s)
     imgs_sec = batch / (ms / 1e3)
     # MLPerf-class A100 ResNet-50 ≈ 2900 imgs/sec/GPU (amp, DALI input)
     ref = 2900.0 if on_tpu else float("nan")
@@ -261,20 +312,25 @@ def main():
     jax, backend = _backend_with_timeout()
     import jax.numpy as jnp
 
+    from apex_tpu.utils.benchtime import measure_fetch_floor
+
     on_tpu = backend == "tpu"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     chip = _CHIP.get(gen, _CHIP["v5e"])
+    floor_s = measure_fetch_floor()
 
-    suite = {"backend": backend, "chip": gen if on_tpu else "cpu-smoke"}
+    suite = {"backend": backend, "chip": gen if on_tpu else "cpu-smoke",
+             "fetch_floor_ms": round(floor_s * 1e3, 1)}
     headline = None
     benches = [("fused_adam_1b", bench_fused_adam),
                ("layer_norm", bench_layer_norm),
                ("flash_attention", bench_flash_attention),
+               ("softmax_rope", bench_softmax_rope),
                ("resnet50_train", bench_resnet50)]
     for name, fn in benches:
         try:
             t0 = time.perf_counter()
-            entry = fn(jax, jnp, on_tpu, chip)
+            entry = fn(jax, jnp, on_tpu, chip, floor_s)
             entry["bench_wall_s"] = round(time.perf_counter() - t0, 1)
             suite[name] = entry
             print(f"[bench] {name}: {entry}", file=sys.stderr)

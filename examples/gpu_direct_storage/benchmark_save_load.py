@@ -1,0 +1,80 @@
+"""Device→storage checkpoint throughput benchmark — the TPU equivalent of
+``apex/contrib/examples/gpu_direct_storage/benchmark_{save,load}.py``.
+
+The reference benchmarks ``_apex_gpu_direct_storage`` (GDSFile save/load),
+whose point is moving GPU memory to disk without a host bounce buffer. On
+TPU the runtime owns device memory and the direct path is orbax's async
+sharded checkpointing (device arrays handed to a background writer;
+OCDBT storage format), with a numpy .npz host-staged path as the
+"no-GDS" comparison — the same yes-GDS/no-GDS A/B the reference runs.
+
+Usage: python benchmark_save_load.py [workdir]
+Prints bytes/sec for each size, save and load, both paths.
+"""
+
+import os
+import shutil
+import sys
+import tempfile
+import timeit
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.utils import checkpoint as ckpt
+
+
+def _bench(label, fn, reps=3):
+    fn()  # warmup
+    t = timeit.timeit(fn, number=reps) / reps
+    return t
+
+
+def run(workdir: str):
+    print(f"backend: {jax.default_backend()}")
+    for logn in (20, 24, 26):
+        size = 2 ** logn
+        x = jnp.linspace(0.0, 1.0, size, dtype=jnp.float32)
+        jax.block_until_ready(x)
+        nbytes = size * 4
+        tree = {"x": x}
+
+        orbax_dir = os.path.join(workdir, f"orbax_{size}")
+        npz_path = os.path.join(workdir, f"np_{size}.npz")
+
+        def save_orbax():
+            if os.path.exists(orbax_dir):
+                shutil.rmtree(orbax_dir)
+            ckpt.save(orbax_dir, tree)
+
+        def load_orbax():
+            return ckpt.restore(orbax_dir, tree)
+
+        def save_np():
+            ckpt.save_numpy(npz_path, tree)
+
+        def load_np():
+            return ckpt.restore_numpy(npz_path, tree)
+
+        for label, fn in (("orbax_save", save_orbax),
+                          ("orbax_load", load_orbax),
+                          ("npz_save", save_np),
+                          ("npz_load", load_np)):
+            try:
+                t = _bench(label, fn)
+                print(f"{label}: size={size} ({nbytes/2**20:.0f} MiB)  "
+                      f"{t*1e3:.1f} ms  {nbytes/t/2**30:.2f} GiB/s")
+            except Exception as e:
+                print(f"{label}: size={size} FAILED {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    wd = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp(
+        prefix="apex_tpu_gds_")
+    os.makedirs(wd, exist_ok=True)
+    try:
+        run(wd)
+    finally:
+        if len(sys.argv) <= 1:
+            shutil.rmtree(wd, ignore_errors=True)

@@ -492,3 +492,67 @@ class TestPermutationSearch:
         pw, perm = permute_channels_to_preserve_magnitude(w)
         np.testing.assert_allclose(np.asarray(pw),
                                    np.asarray(w)[:, perm])
+
+
+class TestASPCheckpointFlow:
+    """The reference's two-part checkpointing flow
+    (apex/contrib/sparsity/test/checkpointing_test_part1.py → part2):
+    train dense → prune → train sparse → checkpoint; then restore into a
+    FRESH model/optimizer/ASP and verify masks + sparsity survive continued
+    training."""
+
+    def _loss_grads(self, params, x):
+        def loss(ps):
+            h = x @ ps[0]
+            return jnp.mean((h + ps[1]) ** 2)
+        return jax.value_and_grad(loss)(params)
+
+    def test_prune_checkpoint_restore_retrain(self, tmp_path):
+        from apex_tpu.utils import checkpoint as ckpt
+
+        x = jax.random.normal(jax.random.PRNGKey(9), (4, 16))
+        params = [jax.random.normal(jax.random.PRNGKey(0), (16, 16)),
+                  jnp.zeros((16,))]
+        opt = FusedAdam(params, lr=0.05)
+        # part 1: dense steps, then prune, then sparse steps
+        p = opt.parameters
+        for _ in range(2):
+            _, g = self._loss_grads(p, x)
+            p = opt.step(g)
+        asp = ASP()
+        pruned = asp.prune_trained_model(p, opt)
+        opt.set_parameters(pruned)
+        p = opt.parameters
+        for _ in range(2):
+            _, g = self._loss_grads(p, x)
+            p = opt.step(g)
+        m = np.asarray(asp.masks[0])
+        np.testing.assert_array_equal(np.asarray(p[0])[~m], 0.0)
+        # the string `pattern` field rides outside the array tree (the
+        # reference stores it in the torch pickle; npz holds arrays only)
+        ckpt.save_numpy(str(tmp_path / "part1.npz"),
+                        {"params": p, "opt": opt.state_dict(),
+                         "asp_masks": asp.state_dict()["masks"]})
+
+        # part 2: fresh everything, restore, keep training sparse
+        params2 = [jnp.zeros((16, 16)), jnp.zeros((16,))]
+        tmpl = {"params": params2,
+                "opt": FusedAdam(params2, lr=0.05).state_dict(),
+                "asp_masks": ASP().init_model_for_pruning(
+                    params2).state_dict()["masks"]}
+        restored = ckpt.restore_numpy(str(tmp_path / "part1.npz"), tmpl)
+        opt2 = FusedAdam(restored["params"], lr=0.05)
+        opt2.load_state_dict(restored["opt"])
+        asp2 = ASP()
+        asp2.load_state_dict({"pattern": "m4n2_1d",
+                              "masks": restored["asp_masks"]})
+        opt2.set_parameters(jax.tree_util.tree_map(
+            lambda q, mk: q * mk, restored["params"], asp2.masks))
+        asp2.wrap_optimizer(opt2)  # part2 re-attaches ASP to the new opt
+        np.testing.assert_array_equal(np.asarray(asp2.masks[0]), m)
+        p2 = opt2.parameters
+        for _ in range(3):
+            _, g = self._loss_grads(p2, x)
+            p2 = opt2.step(g)
+        # sparsity maintained through post-restore training
+        np.testing.assert_array_equal(np.asarray(p2[0])[~m], 0.0)

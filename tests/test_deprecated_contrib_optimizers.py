@@ -1,0 +1,127 @@
+"""Deprecated contrib optimizer surface (legacy ``fused_adam_cuda`` flow) —
+parity with the reference semantics of
+apex/contrib/optimizers/fused_{adam,sgd}.py: explicit ``grads=``,
+``output_params=`` low-precision copy-out, ``scale`` divisor,
+``eps_inside_sqrt``, momentum first-step buffer = grad."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.optimizers import FusedAdam, FusedSGD
+
+
+def _np(x):
+    return np.asarray(x, np.float64)
+
+
+class TestDeprecatedFusedAdam:
+    def _ref_step(self, p, g, m, v, *, lr, b1, b2, eps, wd, step, scale,
+                  eps_inside):
+        """Mirror of adam_cuda_kernel (fused_adam_cuda_kernel.cu:49-60 with
+        host step_size :182-189): raw v in the denom, bias correction folded
+        into step_size, decay joins the update term after the moments."""
+        g = g / scale
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        bc1 = 1 - b1 ** step
+        bc2 = 1 - b2 ** step
+        step_size = lr * np.sqrt(bc2) / bc1
+        if eps_inside:
+            denom = np.sqrt(v + eps)
+        else:
+            denom = np.sqrt(v) + eps
+        return p - step_size * (m / denom + wd * p), m, v
+
+    @pytest.mark.parametrize("eps_inside,scale", [(False, 1.0), (True, 4.0)])
+    def test_step_parity(self, eps_inside, scale):
+        key = jax.random.PRNGKey(0)
+        p = [jax.random.normal(key, (31,), jnp.float32),
+             jax.random.normal(jax.random.PRNGKey(1), (7, 5), jnp.float32)]
+        g = [jax.random.normal(jax.random.PRNGKey(2), (31,), jnp.float32),
+             jax.random.normal(jax.random.PRNGKey(3), (7, 5), jnp.float32)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedAdam(p, lr=1e-2, weight_decay=0.01,
+                            eps_inside_sqrt=eps_inside)
+        ref_p = [_np(x) for x in p]
+        ref_m = [np.zeros_like(x) for x in ref_p]
+        ref_v = [np.zeros_like(x) for x in ref_p]
+        params = p
+        for step in range(1, 4):
+            scaled = [x * scale for x in g]
+            params = opt.step(grads=scaled, scale=scale)
+            for i in range(2):
+                ref_p[i], ref_m[i], ref_v[i] = self._ref_step(
+                    ref_p[i], _np(g[i]), ref_m[i], ref_v[i], lr=1e-2,
+                    b1=0.9, b2=0.999, eps=1e-8, wd=0.01, step=step,
+                    scale=1.0, eps_inside=eps_inside)
+        for got, want in zip(params, ref_p):
+            np.testing.assert_allclose(_np(got), want, rtol=2e-5, atol=2e-6)
+
+    def test_output_params_lowprec_copy(self):
+        p = [jnp.ones((8,), jnp.float32)]
+        g = [jnp.full((8,), 0.5, jnp.bfloat16)]
+        out = [jnp.zeros((8,), jnp.bfloat16)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedAdam(p, lr=1e-2)
+        params, out_lp = opt.step(grads=g, output_params=out)
+        assert out_lp[0].dtype == jnp.bfloat16
+        np.testing.assert_allclose(_np(out_lp[0]),
+                                   _np(params[0].astype(jnp.bfloat16)))
+
+
+class TestDeprecatedFusedSGD:
+    def test_momentum_first_step_is_grad(self):
+        p = [jnp.ones((16,), jnp.float32)]
+        g = [jnp.full((16,), 2.0, jnp.float32)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedSGD(p, lr=0.1, momentum=0.9)
+        params = opt.step(grads=g)
+        # first step: buf = g (not (1-damp)*g), p -= lr*g
+        np.testing.assert_allclose(_np(params[0]), 1.0 - 0.1 * 2.0,
+                                   rtol=1e-6)
+        params = opt.step(grads=g)
+        # second: buf = 0.9*2 + 2 = 3.8
+        np.testing.assert_allclose(_np(params[0]),
+                                   1.0 - 0.1 * 2.0 - 0.1 * 3.8, rtol=1e-6)
+
+    def test_scale_and_wd_after_momentum(self):
+        p = [jnp.full((4,), 2.0, jnp.float32)]
+        g = [jnp.full((4,), 8.0, jnp.float32)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedSGD(p, lr=0.5, momentum=0.0, weight_decay=0.1,
+                           wd_after_momentum=True)
+        params = opt.step(grads=g, scale=4.0)
+        # g/scale = 2; wd after: g += 0.1*2 = 2.2; p = 2 - 0.5*2.2
+        np.testing.assert_allclose(_np(params[0]), 2.0 - 0.5 * 2.2,
+                                   rtol=1e-6)
+
+
+class TestLoggingUtils:
+    def test_average_meter_and_metric_logger(self, tmp_path):
+        from apex_tpu.utils import AverageMeter, MetricLogger
+        m = AverageMeter("loss", ":.2f")
+        m.update(2.0)
+        m.update(4.0)
+        assert m.avg == 3.0
+        path = tmp_path / "metrics.jsonl"
+        ml = MetricLogger(jsonl_path=str(path))
+        ml.log(1, loss=jnp.float32(1.5), lr=0.1)
+        ml.log(2, loss=jnp.float32(0.5), lr=0.1)
+        s = ml.summary()
+        assert abs(s["loss"] - 1.0) < 1e-6
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 2
+
+    def test_one_time_warning_once(self, capsys):
+        from apex_tpu.utils.logging import one_time_warning
+        one_time_warning("only-once-xyz")
+        one_time_warning("only-once-xyz")
+        assert capsys.readouterr().err.count("only-once-xyz") == 1
