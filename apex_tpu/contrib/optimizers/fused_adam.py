@@ -56,16 +56,35 @@ class FusedAdam:
 
     def step(self, closure=None, grads: Any = None,
              output_params: Any = None, scale: float = 1.0,
-             grad_norms=None, lr: Optional[float] = None):
+             grad_norms=None, lr: Optional[float] = None,
+             inv_scale=None, found_inf=False):
         """Legacy step. ``grads`` may be lower precision than params (master
         flow); ``scale`` divides grads first; returns updated params, or
         ``(params, output_params)`` when ``output_params`` is not None
         (a pytree/list matching params whose dtype is reused for the
-        low-precision copy-out)."""
+        low-precision copy-out).
+
+        Also accepts the package's modern calling convention
+        (``step(grads, lr=..., inv_scale=..., found_inf=...)``) so
+        FP16_Optimizer can wrap this class like the reference pairing:
+        a non-callable first positional is treated as ``grads``."""
+        if closure is not None and not callable(closure):
+            closure, grads = None, closure
         loss = closure() if closure is not None else None
         if grads is None:
             raise ValueError("the deprecated flow passes grads explicitly")
-        self._step += 1
+        if inv_scale is not None:
+            scale = 1.0 / inv_scale
+        # reference flow: an overflow step never reaches the kernel, so the
+        # step count must not advance on skipped steps (concrete found_inf
+        # only; traced values fall through — the where() keeps state anyway)
+        try:
+            if bool(found_inf):
+                found_inf = True
+            else:
+                self._step += 1
+        except Exception:
+            self._step += 1
         lr = self.lr if lr is None else lr
         b1, b2 = self.betas
 
@@ -78,15 +97,20 @@ class FusedAdam:
             combined = combined * jnp.maximum(clip, 1.0)
 
         # legacy kernel folds bias correction into step_size and keeps v raw
-        # (fused_adam_cuda_kernel.cu:182-189)
+        # (fused_adam_cuda_kernel.cu:182-189). max(step, 1): when the very
+        # first call is an overflow-skip, _step is still 0 and the (discarded)
+        # update must not divide by bc1 == 0
+        step_for_bc = max(self._step, 1)
         if self.bias_correction:
-            bc1 = 1.0 - b1 ** self._step
-            bc2 = 1.0 - b2 ** self._step
+            bc1 = 1.0 - b1 ** step_for_bc
+            bc2 = 1.0 - b2 ** step_for_bc
             step_size = lr * (bc2 ** 0.5) / bc1
         else:
             step_size = lr
 
         eps, wd, eps_mode = self.eps, self.weight_decay, self.eps_mode
+
+        keep = jnp.asarray(found_inf)
 
         def upd(p, g, m, v):
             p32 = p.astype(jnp.float32)
@@ -101,16 +125,25 @@ class FusedAdam:
             # (fused_adam_cuda_kernel.cu:58)
             update = m_new / denom + wd * p32
             p32 = p32 - step_size * update
-            return p32.astype(p.dtype), m_new, v_new
+            return (jnp.where(keep, p, p32.astype(p.dtype)),
+                    jnp.where(keep, m, m_new), jnp.where(keep, v, v_new))
 
-        flat = jax.tree_util.tree_map(upd, self.parameters, grads,
-                                      self.exp_avg, self.exp_avg_sq)
-        self.parameters = jax.tree_util.tree_map(
-            lambda t: t[0], flat, is_leaf=lambda x: isinstance(x, tuple))
-        self.exp_avg = jax.tree_util.tree_map(
-            lambda t: t[1], flat, is_leaf=lambda x: isinstance(x, tuple))
-        self.exp_avg_sq = jax.tree_util.tree_map(
-            lambda t: t[2], flat, is_leaf=lambda x: isinstance(x, tuple))
+        # unzip by flattening on the PARAMS treedef (a tree_map with
+        # is_leaf=tuple would mis-fire when the params container itself is
+        # a tuple)
+        treedef = jax.tree_util.tree_structure(self.parameters)
+        results = [
+            upd(p, g, m, v) for p, g, m, v in zip(
+                jax.tree_util.tree_leaves(self.parameters),
+                jax.tree_util.tree_leaves(grads),
+                jax.tree_util.tree_leaves(self.exp_avg),
+                jax.tree_util.tree_leaves(self.exp_avg_sq))]
+        self.parameters = jax.tree_util.tree_unflatten(
+            treedef, [r[0] for r in results])
+        self.exp_avg = jax.tree_util.tree_unflatten(
+            treedef, [r[1] for r in results])
+        self.exp_avg_sq = jax.tree_util.tree_unflatten(
+            treedef, [r[2] for r in results])
 
         if output_params is not None:
             out = jax.tree_util.tree_map(

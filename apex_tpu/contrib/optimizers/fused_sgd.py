@@ -42,16 +42,32 @@ class FusedSGD:
 
     def step(self, closure=None, grads: Any = None,
              output_params: Any = None, scale: float = 1.0,
-             grad_norms=None, lr: Optional[float] = None):
+             grad_norms=None, lr: Optional[float] = None,
+             inv_scale=None, found_inf=False):
+        """Legacy step; also accepts the modern
+        ``step(grads, lr=..., inv_scale=..., found_inf=...)`` convention so
+        FP16_Optimizer can wrap this class (see fused_adam.py)."""
+        if closure is not None and not callable(closure):
+            closure, grads = None, closure
         loss = closure() if closure is not None else None
         if grads is None:
             raise ValueError("the deprecated flow passes grads explicitly")
+        if inv_scale is not None:
+            scale = 1.0 / inv_scale
         lr = self.lr if lr is None else lr
         mom, damp, wd = self.momentum, self.dampening, self.weight_decay
         nesterov, wd_after = self.nesterov, self.wd_after_momentum
         first = self._first
-        self._first = False
-        inv = 1.0 / float(scale)
+        # overflow-skipped steps must not consume the first-step flag
+        # (reference: the kernel is never launched on overflow)
+        try:
+            if not bool(found_inf):
+                self._first = False
+        except Exception:
+            self._first = False
+        inv = 1.0 / float(scale) if not hasattr(scale, "dtype") \
+            else 1.0 / scale
+        keep = jnp.asarray(found_inf)
 
         def upd(p, g, buf):
             p32 = p.astype(jnp.float32)
@@ -59,20 +75,26 @@ class FusedSGD:
             if wd and not wd_after:
                 g32 = g32 + wd * p32
             if mom:
-                buf = g32 if first else mom * buf + (1.0 - damp) * g32
-                g32 = g32 + mom * buf if nesterov else buf
+                buf_new = g32 if first else mom * buf + (1.0 - damp) * g32
+                g32 = g32 + mom * buf_new if nesterov else buf_new
+            else:
+                buf_new = buf
             if wd and wd_after:
                 g32 = g32 + wd * p32
-            p32 = p32 - lr * g32
-            return p32.astype(p.dtype), buf
+            p_new = (p32 - lr * g32).astype(p.dtype)
+            return jnp.where(keep, p, p_new), jnp.where(keep, buf, buf_new)
 
-        flat = jax.tree_util.tree_map(upd, self.parameters, grads,
-                                      self.momentum_buffer)
-        is_t = lambda x: isinstance(x, tuple)  # noqa: E731
-        self.parameters = jax.tree_util.tree_map(lambda t: t[0], flat,
-                                                 is_leaf=is_t)
-        self.momentum_buffer = jax.tree_util.tree_map(lambda t: t[1], flat,
-                                                      is_leaf=is_t)
+        # unzip on the params treedef (not is_leaf=tuple — see fused_adam)
+        treedef = jax.tree_util.tree_structure(self.parameters)
+        results = [
+            upd(p, g, buf) for p, g, buf in zip(
+                jax.tree_util.tree_leaves(self.parameters),
+                jax.tree_util.tree_leaves(grads),
+                jax.tree_util.tree_leaves(self.momentum_buffer))]
+        self.parameters = jax.tree_util.tree_unflatten(
+            treedef, [r[0] for r in results])
+        self.momentum_buffer = jax.tree_util.tree_unflatten(
+            treedef, [r[1] for r in results])
 
         if output_params is not None:
             out = jax.tree_util.tree_map(

@@ -125,3 +125,45 @@ class TestLoggingUtils:
         one_time_warning("only-once-xyz")
         one_time_warning("only-once-xyz")
         assert capsys.readouterr().err.count("only-once-xyz") == 1
+
+
+class TestModernCallingConvention:
+    def test_tuple_params_container(self):
+        """Params pytree that IS a tuple must not be mangled by the
+        result unzip (regression: is_leaf=tuple matched the container)."""
+        p = (jnp.ones((4,)), jnp.ones((2, 2)))
+        g = (jnp.full((4,), 0.5), jnp.full((2, 2), 0.5))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedAdam(p, lr=0.1)
+        out = opt.step(grads=g)
+        assert isinstance(out, tuple) and len(out) == 2
+        assert out[0].shape == (4,) and out[1].shape == (2, 2)
+        out = opt.step(grads=g)  # second step exercises state structure
+        assert out[1].shape == (2, 2)
+
+    def test_fp16_optimizer_wraps_legacy_adam(self):
+        """The reference pairing: FP16_Optimizer over the deprecated
+        contrib FusedAdam (modern step(grads, lr=, inv_scale=, found_inf=)
+        convention accepted)."""
+        from apex_tpu.contrib.optimizers import FP16_Optimizer
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FP16_Optimizer(FusedAdam([jnp.ones((8,))], lr=0.1),
+                                 dynamic_loss_scale=True,
+                                 dynamic_loss_args={"init_scale": 64.0})
+        p = opt.step([jnp.full((8,), 64.0)])  # true grad 1.0
+        assert not np.allclose(np.asarray(p[0]), 1.0)
+        # overflow grads: step skipped, scale halved
+        p2 = opt.step([jnp.full((8,), np.inf)])
+        np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(p[0]))
+        assert opt.loss_scale == 32.0
+
+    def test_legacy_sgd_found_inf_skips(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedSGD([jnp.ones((4,))], lr=0.1, momentum=0.9)
+        p = opt.step(grads=[jnp.ones((4,))], found_inf=jnp.bool_(True))
+        np.testing.assert_array_equal(np.asarray(p[0]), 1.0)
+        p = opt.step(grads=[jnp.ones((4,))], found_inf=jnp.bool_(False))
+        assert float(p[0][0]) < 1.0
