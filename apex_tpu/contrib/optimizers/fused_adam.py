@@ -27,6 +27,28 @@ import jax.numpy as jnp
 from apex_tpu.utils.logging import deprecated_warning
 
 
+def revive_state(val, fallback):
+    """Recover a legacy-optimizer SCALAR state leaked out of a dead trace.
+
+    These stateful classes are eager-API by contract. When a caller jits
+    around a persistent optimizer, a ``found_inf``-traced step leaves
+    tracers in ``self._step``/``self._first`` (and in the moment trees —
+    the persistent-object-under-jit pattern is NOT supported and still
+    raises UnexpectedTracerError at the moment leaves; construct the
+    optimizer inside the trace, or use the modern functional API). This
+    helper keeps the step counter and ``state_dict`` checkpointing sane
+    regardless: it detects a dead tracer by probing it with a no-op add and
+    falls back to the host-side mirror, which counts every traced step as
+    applied — the best a host counter can know."""
+    if not isinstance(val, jax.core.Tracer):
+        return val
+    try:
+        val + 0  # live tracers (same active trace) tolerate ops; dead raise
+        return val
+    except Exception:
+        return fallback
+
+
 class FusedAdam:
     def __init__(self, params: Any, lr: float = 1e-3,
                  bias_correction: bool = True, betas=(0.9, 0.999),
@@ -50,6 +72,7 @@ class FusedAdam:
         self.max_grad_norm = max_grad_norm
         self._amp_scale_adjustment = amp_scale_adjustment
         self._step = 0
+        self._step_host = 0  # trace-independent mirror, see revive_state
         f32 = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
         self.exp_avg = jax.tree_util.tree_map(f32, params)
         self.exp_avg_sq = jax.tree_util.tree_map(f32, params)
@@ -76,15 +99,26 @@ class FusedAdam:
         if inv_scale is not None:
             scale = 1.0 / inv_scale
         # reference flow: an overflow step never reaches the kernel, so the
-        # step count must not advance on skipped steps (concrete found_inf
-        # only; traced values fall through — the where() keeps state anyway)
-        try:
-            if bool(found_inf):
-                found_inf = True
-            else:
-                self._step += 1
-        except Exception:
+        # step count must not advance on skipped steps. For a concrete
+        # found_inf this is a host-side int; for a traced one (caller jits
+        # around this legacy class) the count becomes a device scalar
+        # advanced by where(), so bias correction stays consistent with the
+        # number of APPLIED updates within the trace; revive_state recovers
+        # persistent objects whose counter outlived that trace.
+        self._step = revive_state(self._step, self._step_host)
+        fi = jnp.asarray(found_inf)
+        static_skip: Optional[bool]  # None = data-dependent
+        if (isinstance(fi, jax.core.Tracer)
+                or isinstance(self._step, jax.core.Tracer)):
+            static_skip = None
+            self._step = self._step + jnp.where(fi, 0, 1)
+            self._step_host += 1
+        elif bool(fi):
+            static_skip = True
+        else:
+            static_skip = False
             self._step += 1
+            self._step_host = int(self._step)
         lr = self.lr if lr is None else lr
         b1, b2 = self.betas
 
@@ -100,7 +134,10 @@ class FusedAdam:
         # (fused_adam_cuda_kernel.cu:182-189). max(step, 1): when the very
         # first call is an overflow-skip, _step is still 0 and the (discarded)
         # update must not divide by bc1 == 0
-        step_for_bc = max(self._step, 1)
+        if isinstance(self._step, jax.Array):
+            step_for_bc = jnp.maximum(self._step, 1)
+        else:
+            step_for_bc = max(self._step, 1)
         if self.bias_correction:
             bc1 = 1.0 - b1 ** step_for_bc
             bc2 = 1.0 - b2 ** step_for_bc
@@ -110,7 +147,7 @@ class FusedAdam:
 
         eps, wd, eps_mode = self.eps, self.weight_decay, self.eps_mode
 
-        keep = jnp.asarray(found_inf)
+        keep = fi
 
         def upd(p, g, m, v):
             p32 = p.astype(jnp.float32)
@@ -125,6 +162,9 @@ class FusedAdam:
             # (fused_adam_cuda_kernel.cu:58)
             update = m_new / denom + wd * p32
             p32 = p32 - step_size * update
+            if static_skip is False:
+                # predicate statically clean — no full-tensor selects
+                return p32.astype(p.dtype), m_new, v_new
             return (jnp.where(keep, p, p32.astype(p.dtype)),
                     jnp.where(keep, m, m_new), jnp.where(keep, v, v_new))
 
@@ -157,10 +197,10 @@ class FusedAdam:
         return self.parameters
 
     def state_dict(self):
-        return {"step": self._step, "exp_avg": self.exp_avg,
-                "exp_avg_sq": self.exp_avg_sq}
+        return {"step": revive_state(self._step, self._step_host),
+                "exp_avg": self.exp_avg, "exp_avg_sq": self.exp_avg_sq}
 
     def load_state_dict(self, sd):
-        self._step = int(sd["step"])
+        self._step = self._step_host = int(sd["step"])
         self.exp_avg = sd["exp_avg"]
         self.exp_avg_sq = sd["exp_avg_sq"]

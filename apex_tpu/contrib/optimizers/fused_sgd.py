@@ -37,6 +37,7 @@ class FusedSGD:
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
         self._first = True
+        self._first_host = True  # see fused_adam.revive_state
         self.momentum_buffer = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
 
@@ -57,17 +58,34 @@ class FusedSGD:
         lr = self.lr if lr is None else lr
         mom, damp, wd = self.momentum, self.dampening, self.weight_decay
         nesterov, wd_after = self.nesterov, self.wd_after_momentum
-        first = self._first
         # overflow-skipped steps must not consume the first-step flag
-        # (reference: the kernel is never launched on overflow)
-        try:
-            if not bool(found_inf):
+        # (reference: the kernel is never launched on overflow). With a
+        # traced found_inf (caller jits around this legacy class) the flag
+        # itself goes data-dependent: it stays True only while every step so
+        # far was skipped, and the first-step momentum init becomes a
+        # where() select on it.
+        from apex_tpu.contrib.optimizers.fused_adam import revive_state
+        self._first = revive_state(self._first, self._first_host)
+        fi = jnp.asarray(found_inf)
+        traced = (isinstance(fi, jax.core.Tracer)
+                  or isinstance(self._first, jax.core.Tracer))
+        static_skip: Optional[bool]  # None = data-dependent
+        if traced:
+            static_skip = None
+            first = jnp.asarray(self._first)
+            self._first = jnp.logical_and(first, fi)
+            self._first_host = False  # host mirror counts the step applied
+        else:
+            first = bool(self._first)
+            if bool(fi):
+                static_skip = True
+            else:
+                static_skip = False
                 self._first = False
-        except Exception:
-            self._first = False
+                self._first_host = False
         inv = 1.0 / float(scale) if not hasattr(scale, "dtype") \
             else 1.0 / scale
-        keep = jnp.asarray(found_inf)
+        keep = fi
 
         def upd(p, g, buf):
             p32 = p.astype(jnp.float32)
@@ -75,13 +93,19 @@ class FusedSGD:
             if wd and not wd_after:
                 g32 = g32 + wd * p32
             if mom:
-                buf_new = g32 if first else mom * buf + (1.0 - damp) * g32
+                cont = mom * buf + (1.0 - damp) * g32
+                if isinstance(first, bool):
+                    buf_new = g32 if first else cont
+                else:
+                    buf_new = jnp.where(first, g32, cont)
                 g32 = g32 + mom * buf_new if nesterov else buf_new
             else:
                 buf_new = buf
             if wd and wd_after:
                 g32 = g32 + wd * p32
             p_new = (p32 - lr * g32).astype(p.dtype)
+            if static_skip is False:
+                return p_new, buf_new
             return jnp.where(keep, p, p_new), jnp.where(keep, buf, buf_new)
 
         # unzip on the params treedef (not is_leaf=tuple — see fused_adam)
@@ -108,9 +132,10 @@ class FusedSGD:
         return self.parameters
 
     def state_dict(self):
+        from apex_tpu.contrib.optimizers.fused_adam import revive_state
         return {"momentum_buffer": self.momentum_buffer,
-                "first": self._first}
+                "first": revive_state(self._first, self._first_host)}
 
     def load_state_dict(self, sd):
         self.momentum_buffer = sd["momentum_buffer"]
-        self._first = bool(sd["first"])
+        self._first = self._first_host = bool(sd["first"])

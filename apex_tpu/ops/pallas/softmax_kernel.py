@@ -1,0 +1,164 @@
+"""Pallas TPU kernel for the megatron attention-score softmax family.
+
+Reference: ``csrc/megatron/scaled_masked_softmax.h`` warp kernels (:106
+unmasked, :211 arbitrary mask, scaled_upper_triang_masked_softmax.h:130
+causal) and their backward chains (:106-207). Semantics preserved: scale
+applied first, masked positions REPLACED with -10000.0, fully-masked rows
+output zeros, math in fp32 regardless of IO dtype.
+
+TPU design: one grid step owns a (block_rows, sk) row-complete tile resident
+in VMEM, so the max / exp / sum / divide chain touches HBM exactly once per
+element (read x, write y) — the XLA jnp lowering re-reads the input for each
+reduction pass, which caps it at ~1/3 of HBM peak; this kernel removes those
+extra passes. The backward needs only y and dy (masked positions have y == 0
+so their dx is exactly 0 without consulting the mask — same trick as the
+reference backward kernels, which also take no mask).
+
+The mask is streamed block-wise with broadcast dims UNMATERIALIZED, matching
+the reference's (b, 1, sq, sk) mask vs (b, h, sq, sk) scores convention.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils.env import interpret_default
+from apex_tpu.utils.tiling import round_up as _round_up
+
+_f32 = jnp.float32
+MASK_FILL = -10000.0
+# largest row length the VMEM-resident tile supports (fp32 working set);
+# beyond this the caller falls back to the XLA path (the "generic" variant
+# has no length limit, like generic_scaled_masked_softmax.cpp:58-61)
+MAX_PALLAS_COLS = 16384
+
+
+def _pick_rows(skp: int, sq: int, itemsize: int = 4,
+               has_mask: bool = False) -> int:
+    """Row-block size from a per-grid-step VMEM budget covering EVERY
+    streamed operand — in + out tiles (double-buffered by the pipeline) plus
+    the int32 mask tile and the fp32 compute temporaries — so fp32+mask at
+    MAX_PALLAS_COLS still fits v5e's ~16 MB VMEM. Clamped to ≥ 8 sublanes
+    and to the (8-rounded) row count so short-sq (decode-style) scores are
+    not padded to a full block."""
+    bytes_per_elt = 2 * (2 * itemsize + (4 if has_mask else 0)) + 8
+    br = (10 << 20) // (skp * bytes_per_elt)
+    br = max(8, min(512, _round_up(br, 8) if br >= 8 else 8))
+    return min(br, _round_up(sq, 8))
+
+
+def _sm_fwd_kernel(*refs, scale, causal, has_mask, sk_orig, br, skp):
+    if has_mask:
+        x_ref, m_ref, o_ref = refs
+    else:
+        x_ref, o_ref = refs
+        m_ref = None
+    qi = pl.program_id(1)
+    x32 = x_ref[0].astype(_f32) * scale
+    if has_mask:
+        x32 = jnp.where(m_ref[0] != 0, MASK_FILL, x32)
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (br, skp), 0) + qi * br
+        cols = jax.lax.broadcasted_iota(jnp.int32, (br, skp), 1)
+        x32 = jnp.where(cols > rows, MASK_FILL, x32)
+    if skp != sk_orig:
+        cols = jax.lax.broadcasted_iota(jnp.int32, (br, skp), 1)
+        x32 = jnp.where(cols >= sk_orig, MASK_FILL, x32)
+    m = jnp.max(x32, axis=-1, keepdims=True)
+    e = jnp.exp(x32 - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    y = e / s
+    # fully-masked row (max == fill) → zeros, scaled_masked_softmax.h:297
+    y = jnp.where(m <= MASK_FILL, 0.0, y)
+    o_ref[0] = y.astype(o_ref.dtype)
+
+
+def _sm_bwd_kernel(y_ref, dy_ref, dx_ref, *, scale):
+    y32 = y_ref[0].astype(_f32)
+    dy32 = dy_ref[0].astype(_f32)
+    c = jnp.sum(dy32 * y32, axis=-1, keepdims=True)
+    dx_ref[0] = ((dy32 - c) * y32 * scale).astype(dx_ref.dtype)
+
+
+def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
+    """x3: (B, sq, sk) scores (B = b·h). mask3: None or (Bm, sqm, sk) with
+    Bm in {1, B//h·? } — concretely Bm in {1, B // h} (the reference's
+    per-batch mask shared across heads) or B; sqm in {1, sq}. 1/True =
+    masked."""
+    if interpret is None:
+        interpret = interpret_default()
+    B, sq, sk = x3.shape
+    skp = _round_up(sk, 128)
+    br = _pick_rows(skp, sq, x3.dtype.itemsize, mask3 is not None)
+    sqp = _round_up(sq, br)
+    xp = jnp.pad(x3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+    grid = (B, sqp // br)
+
+    in_specs = [pl.BlockSpec((1, br, skp), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)]
+    operands = [xp]
+    has_mask = mask3 is not None
+    if has_mask:
+        Bm, sqm, _ = mask3.shape
+        mp = jnp.pad(mask3.astype(jnp.int32),
+                     ((0, 0), (0, sqp - sq if sqm != 1 else 0),
+                      (0, skp - sk)))
+        full_q = sqm != 1
+        if Bm == 1:
+            bidx = lambda b: 0  # noqa: E731
+        elif Bm == B:
+            bidx = lambda b: b  # noqa: E731
+        else:  # per-batch mask shared across h heads
+            assert Bm * h == B, (Bm, h, B)
+            bidx = lambda b: b // h  # noqa: E731
+        in_specs.append(pl.BlockSpec(
+            (1, br if full_q else 1, skp),
+            lambda b, i: (bidx(b), i if full_q else 0, 0),
+            memory_space=pltpu.VMEM))
+        operands.append(mp)
+
+    out = pl.pallas_call(
+        functools.partial(_sm_fwd_kernel, scale=scale, causal=causal,
+                          has_mask=has_mask, sk_orig=sk, br=br, skp=skp),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, br, skp), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, sqp, skp), x3.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(*operands)
+    return out[:, :sq, :sk]
+
+
+def softmax_bwd_pallas(y3, dy3, *, scale, interpret=None):
+    """dx for any variant: masked positions have y == 0 ⇒ dx == 0, so no
+    mask input is needed (matches the reference backward kernels)."""
+    if interpret is None:
+        interpret = interpret_default()
+    B, sq, sk = y3.shape
+    skp = _round_up(sk, 128)
+    br = _pick_rows(skp, sq, y3.dtype.itemsize)
+    sqp = _round_up(sq, br)
+    # padded cols have y == 0 ⇒ contribute nothing to the row sum
+    yp = jnp.pad(y3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+    dyp = jnp.pad(dy3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+    spec = pl.BlockSpec((1, br, skp), lambda b, i: (b, i, 0),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        functools.partial(_sm_bwd_kernel, scale=scale),
+        grid=(B, sqp // br),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((B, sqp, skp), y3.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(yp, dyp)
+    return out[:, :sq, :sk]

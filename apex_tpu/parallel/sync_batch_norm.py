@@ -38,7 +38,7 @@ def _welford_merge(mean_a, m2_a, n_a, mean_b, m2_b, n_b):
 
 def sync_batch_norm_stats(x: jax.Array, reduce_axes: Sequence[int],
                           axis_name: Optional[str] = None,
-                          axis_index_groups=None):
+                          axis_index_groups=None, shift=None):
     """Cross-replica Welford mean/var over ``reduce_axes`` (+ the device axis).
 
     ``axis_index_groups`` restricts the reduction to device subgroups — the
@@ -49,14 +49,39 @@ def sync_batch_norm_stats(x: jax.Array, reduce_axes: Sequence[int],
     non-reduced (channel) dims.
     """
     x32 = x.astype(_f32)
+    reduce_axes = tuple(a % x.ndim for a in reduce_axes)
     n_local = 1
     for a in reduce_axes:
         n_local *= x.shape[a]
     n_local = jnp.asarray(n_local, _f32)
-    mean_l = jnp.mean(x32, axis=tuple(reduce_axes))
-    var_l = jnp.mean(
-        jnp.square(x32 - jnp.expand_dims(mean_l, tuple(reduce_axes))),
-        axis=tuple(reduce_axes))
+    # SHIFTED one-pass local stats: E[d] and E[d²] for d = x - shift reduce
+    # over a SINGLE read of x (XLA fuses both reductions and the subtract
+    # into one loop), vs the centered two-pass form whose var reduction
+    # re-reads x after mean is known. Plain E[x²]−E[x]² cancels
+    # catastrophically when |mean| ≫ std; shifting by ANY within-a-few-std
+    # estimate of the mean makes the cancellation relative to (mean−shift)²
+    # ≈ std² instead of mean², restoring the robustness of the centered
+    # form at one-pass cost. Default shift: the first element along the
+    # reduced axes per channel (an O(C) read, not a pass) — a sample drawn
+    # from the distribution is within ~std of the mean with overwhelming
+    # probability, so every caller gets the robust path without opting in.
+    # The cross-device merge below stays Welford/Chan (welford.cu:502).
+    if shift is None:
+        idx = tuple(0 if a in reduce_axes else slice(None)
+                    for a in range(x.ndim))
+        shift_c = jax.lax.stop_gradient(x32[idx])
+        bc = tuple(1 if a in reduce_axes else x.shape[a]
+                   for a in range(x.ndim))
+    else:
+        # shift has the channel (non-reduced) shape, e.g. (C,)
+        shift_c = jax.lax.stop_gradient(jnp.asarray(shift, _f32))
+        bc = tuple(1 if a in reduce_axes else x.shape[a]
+                   for a in range(x.ndim))
+    d = x32 - shift_c.reshape(bc)
+    mean_d = jnp.mean(d, axis=reduce_axes)
+    mean2_d = jnp.mean(d * d, axis=reduce_axes)
+    var_l = jnp.maximum(mean2_d - mean_d * mean_d, 0.0)
+    mean_l = shift_c.reshape(mean_d.shape) + mean_d
     m2_l = var_l * n_local
 
     if axis_name is None:

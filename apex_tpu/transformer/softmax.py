@@ -27,8 +27,81 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.pallas.softmax_kernel import (MASK_FILL,
+                                                MAX_PALLAS_COLS,
+                                                softmax_bwd_pallas,
+                                                softmax_fwd_pallas)
+from apex_tpu.utils.env import interpret_default
+
 _f32 = jnp.float32
-MASK_FILL = -10000.0
+
+
+# ------------------------------------------------- Pallas-routed fast path
+#
+# On TPU the row-tiled Pallas kernel (ops/pallas/softmax_kernel.py) reads
+# and writes each element exactly once; the jnp lowering below re-reads the
+# input per reduction pass. CPU/interpret keeps the jnp path (fast under
+# XLA:CPU, and the kernel itself is parity-tested in interpret mode).
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _pallas_softmax(x, mask, scale, causal, h):
+    y, _ = _psm_fwd(x, mask, scale, causal, h)
+    return y
+
+
+def _psm_fwd(x, mask, scale, causal, h):
+    shape = x.shape
+    sq, sk = shape[-2], shape[-1]
+    x3 = x.reshape(-1, sq, sk)
+    m3 = None
+    if mask is not None:
+        m3 = mask.reshape(-1, mask.shape[-2], mask.shape[-1])
+    y3 = softmax_fwd_pallas(x3, m3, scale=scale, causal=causal, h=h)
+    y = y3.reshape(shape)
+    return y, y
+
+
+def _psm_bwd(scale, causal, h, y, dy):
+    shape = y.shape
+    sq, sk = shape[-2], shape[-1]
+    dx3 = softmax_bwd_pallas(y.reshape(-1, sq, sk),
+                             dy.reshape(-1, sq, sk), scale=scale)
+    return dx3.reshape(shape), None
+
+
+_pallas_softmax.defvjp(_psm_fwd, _psm_bwd)
+
+
+def _pallas_route(x, mask, scale, causal):
+    """Return (ok, h): whether the Pallas kernel can take this call, and the
+    head-broadcast factor mapping mask batch rows onto score batch rows."""
+    if interpret_default():
+        return False, 1
+    if x.ndim < 2 or x.shape[-1] > MAX_PALLAS_COLS:
+        return False, 1
+    if mask is None:
+        return True, 1
+    if mask.ndim != x.ndim or mask.shape[-1] != x.shape[-1]:
+        return False, 1
+    if mask.shape[-2] not in (1, x.shape[-2]):
+        return False, 1
+    # supported leading-dim broadcast: a prefix equal to x's dims followed
+    # by all-1s (covers the reference's (b, 1, sq, sk) mask vs (b, h, sq,
+    # sk) scores, all-equal, and all-ones). Then flat mask row = flat score
+    # row // h with h = prod of the broadcast tail.
+    lead_m, lead_x = mask.shape[:-2], x.shape[:-2]
+    bm = bx = 1
+    in_tail = False
+    for a, b in zip(lead_m, lead_x):
+        bx *= b
+        if a == b and not in_tail:
+            bm *= a
+        elif a == 1:
+            in_tail = True
+        else:
+            return False, 1
+    return True, bx // bm
 
 
 def _softmax_rows(x32: jax.Array) -> jax.Array:
@@ -62,6 +135,9 @@ _scaled_softmax.defvjp(_smsm_fwd, _smsm_bwd)
 
 def scaled_softmax(x: jax.Array, scale: float = 1.0) -> jax.Array:
     """≈ ``scaled_softmax_cuda`` (no mask). x: (..., sq, sk)."""
+    ok, h = _pallas_route(x, None, scale, False)
+    if ok:
+        return _pallas_softmax(x, None, scale, False, h)
     return _scaled_softmax(x, scale)
 
 
@@ -72,6 +148,9 @@ def scaled_masked_softmax(x: jax.Array, mask: Optional[jax.Array],
     are filled with -10000 AFTER scaling (replace, not add)."""
     if mask is None:
         return scaled_softmax(x, scale)
+    ok, h = _pallas_route(x, mask, scale, False)
+    if ok:
+        return _pallas_softmax(x, mask, scale, False, h)
     keep = 1.0 - mask.astype(_f32)
     return _scaled_masked_softmax_replace(x, keep, scale)
 
@@ -106,6 +185,9 @@ def scaled_upper_triang_masked_softmax(x: jax.Array,
     x: (..., sq, sk) with sq == sk; position (i, j) masked when j > i
     (scaled_upper_triang_masked_softmax.h:130).
     """
+    ok, h = _pallas_route(x, None, scale, True)
+    if ok:
+        return _pallas_softmax(x, None, scale, True, h)
     sq, sk = x.shape[-2], x.shape[-1]
     rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
     cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)

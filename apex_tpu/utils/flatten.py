@@ -22,13 +22,11 @@ from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
+
+from apex_tpu.utils.tiling import round_up as _round_up
 import numpy as np
 
 LANE = 128  # TPU lane width; keep per-leaf offsets aligned to it.
-
-
-def _round_up(n: int, m: int) -> int:
-    return (n + m - 1) // m * m
 
 
 @dataclasses.dataclass(frozen=True)

@@ -1,0 +1,10 @@
+"""Shared tiling arithmetic for the Pallas kernels and flat-buffer packing
+(the TPU analog of the reference's chunking math in
+csrc/multi_tensor_apply.cuh:13-23)."""
+
+from __future__ import annotations
+
+
+def round_up(n: int, m: int) -> int:
+    """Smallest multiple of ``m`` that is >= ``n``."""
+    return -(-n // m) * m

@@ -167,3 +167,52 @@ class TestModernCallingConvention:
         np.testing.assert_array_equal(np.asarray(p[0]), 1.0)
         p = opt.step(grads=[jnp.ones((4,))], found_inf=jnp.bool_(False))
         assert float(p[0][0]) < 1.0
+
+    def test_traced_found_inf_step_count_consistent(self):
+        """Under jit (traced found_inf) the Adam step counter and the SGD
+        first-step flag go data-dependent instead of silently advancing on
+        skipped steps: skip-then-apply must equal a single applied step."""
+        import jax
+
+        g = jnp.full((4,), 0.5)
+
+        def adam_two_steps(skip_first):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                opt = FusedAdam([jnp.ones((4,))], lr=0.1)
+            opt.step(grads=[g], found_inf=skip_first)
+            (p,) = opt.step(grads=[g], found_inf=jnp.bool_(False))
+            return p
+
+        skip_then_apply = jax.jit(adam_two_steps)(jnp.bool_(True))
+
+        def adam_one_step():
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                opt = FusedAdam([jnp.ones((4,))], lr=0.1)
+            (p,) = opt.step(grads=[g], found_inf=False)
+            return p
+
+        np.testing.assert_allclose(np.asarray(skip_then_apply),
+                                   np.asarray(adam_one_step()), atol=5e-6)
+
+        def sgd_two_steps(skip_first):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                opt = FusedSGD([jnp.ones((4,))], lr=0.1, momentum=0.9)
+            opt.step(grads=[g], found_inf=skip_first)
+            (p,) = opt.step(grads=[g], found_inf=jnp.bool_(False))
+            return p
+
+        sgd_skip = jax.jit(sgd_two_steps)(jnp.bool_(True))
+
+        def sgd_one_step():
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                opt = FusedSGD([jnp.ones((4,))], lr=0.1, momentum=0.9)
+            (p,) = opt.step(grads=[g], found_inf=False)
+            return p
+
+        # first applied step must use the momentum-init (buf = g) path
+        np.testing.assert_allclose(np.asarray(sgd_skip),
+                                   np.asarray(sgd_one_step()), atol=5e-6)

@@ -120,6 +120,32 @@ class TestSyncBatchNorm:
                                    rtol=1e-4, atol=1e-6)
         assert float(count) == WORLD * 4
 
+    def test_stats_large_mean_no_cancellation(self):
+        """|mean| >> std must not cancel catastrophically: the one-pass
+        E[d²]−E[d]² form is computed on d = x − shift where shift defaults
+        to the first sample per channel. fp32 E[x²]−mean² at mean=1000,
+        std=0.1 would have ~0.06 absolute error vs the true var 0.01 —
+        every caller (groupbn included) must get the robust path without
+        opting in."""
+        x = (1000.0
+             + 0.1 * jax.random.normal(jax.random.PRNGKey(1), (4096, 4),
+                                       jnp.float32))
+        mean, var, _ = sync_batch_norm_stats(x, (0,), None)
+        np.testing.assert_allclose(np.asarray(var),
+                                   np.asarray(x).var(0), rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(mean),
+                                   np.asarray(x).mean(0), rtol=1e-6)
+        # explicit shift and negative reduce axes
+        shift = jnp.full((4,), 1000.0, jnp.float32)
+        _, var_s, _ = sync_batch_norm_stats(x, (-2,), None, shift=shift)
+        np.testing.assert_allclose(np.asarray(var_s),
+                                   np.asarray(x).var(0), rtol=1e-3)
+        # NHWC-style multi-axis reduce with a large offset
+        x4 = x.reshape(64, 8, 8, 4)
+        _, var4, _ = sync_batch_norm_stats(x4, (0, 1, 2), None)
+        np.testing.assert_allclose(np.asarray(var4),
+                                   np.asarray(x).var(0), rtol=1e-3)
+
     def test_module_matches_full_batch_bn(self, mesh):
         """SyncBN over shards == plain BN over the concatenated batch."""
         C = 12

@@ -282,3 +282,145 @@ class TestWgrad:
             np.asarray(x, np.float32))
         assert out.dtype == jnp.float32
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-2)
+
+
+class TestPallasSoftmaxKernel:
+    """The TPU-routed Pallas softmax kernel (ops/pallas/softmax_kernel.py),
+    parity-tested in interpret mode against the jnp reference path that CPU
+    callers use (the kernel is what runs on the chip)."""
+
+    def _ref(self, x, mask, scale, causal):
+        x32 = np.asarray(x, np.float32) * scale
+        if mask is not None:
+            x32 = np.where(np.broadcast_to(np.asarray(mask, bool), x32.shape),
+                           -10000.0, x32)
+        if causal:
+            sq, sk = x32.shape[-2:]
+            tri = np.triu(np.ones((sq, sk), bool), 1)
+            x32 = np.where(tri, -10000.0, x32)
+        m = x32.max(-1, keepdims=True)
+        e = np.exp(x32 - m)
+        y = e / e.sum(-1, keepdims=True)
+        return np.where(m <= -10000.0, 0.0, y)
+
+    @pytest.mark.parametrize("sk", [128, 300, 1024])
+    def test_fwd_parity(self, sk):
+        from apex_tpu.ops.pallas.softmax_kernel import softmax_fwd_pallas
+        x = jax.random.normal(jax.random.PRNGKey(0), (3, 12, sk))
+        y = softmax_fwd_pallas(x, None, scale=0.7, causal=False,
+                               interpret=True)
+        np.testing.assert_allclose(np.asarray(y),
+                                   self._ref(x, None, 0.7, False), atol=1e-6)
+
+    def test_fwd_causal_and_ragged(self):
+        from apex_tpu.ops.pallas.softmax_kernel import softmax_fwd_pallas
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 11, 11))
+        y = softmax_fwd_pallas(x, None, scale=1.3, causal=True,
+                               interpret=True)
+        np.testing.assert_allclose(np.asarray(y),
+                                   self._ref(x, None, 1.3, True), atol=1e-6)
+
+    @pytest.mark.parametrize("bm,h", [(6, 1), (1, 1), (2, 3)])
+    def test_fwd_mask_broadcast(self, bm, h):
+        """(b, 1, sq, sk)-style mask sharing across h heads, flattened."""
+        from apex_tpu.ops.pallas.softmax_kernel import softmax_fwd_pallas
+        B = 6
+        x = jax.random.normal(jax.random.PRNGKey(2), (B, 8, 160))
+        mask = jax.random.bernoulli(jax.random.PRNGKey(3), 0.3,
+                                    (bm, 8, 160)).astype(jnp.uint8)
+        y = softmax_fwd_pallas(x, mask, scale=1.0, causal=False, h=h,
+                               interpret=True)
+        mask_full = jnp.repeat(mask, B // bm, axis=0)
+        np.testing.assert_allclose(np.asarray(y),
+                                   self._ref(x, mask_full, 1.0, False),
+                                   atol=1e-6)
+
+    def test_fully_masked_rows_zero(self):
+        from apex_tpu.ops.pallas.softmax_kernel import softmax_fwd_pallas
+        x = jax.random.normal(jax.random.PRNGKey(4), (1, 4, 128))
+        mask = jnp.ones((1, 4, 128), jnp.uint8)
+        y = softmax_fwd_pallas(x, mask, scale=1.0, causal=False,
+                               interpret=True)
+        np.testing.assert_array_equal(np.asarray(y), 0.0)
+
+    def test_bwd_parity(self):
+        from apex_tpu.ops.pallas.softmax_kernel import (softmax_bwd_pallas,
+                                                        softmax_fwd_pallas)
+        x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, 200))
+        dy = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 200))
+        scale = 1.9
+
+        def ref_fn(x):
+            return jax.nn.softmax(x * scale, axis=-1)
+
+        y, vjp = jax.vjp(ref_fn, x)
+        (dx_ref,) = vjp(dy)
+        yk = softmax_fwd_pallas(x, None, scale=scale, causal=False,
+                                interpret=True)
+        dx = softmax_bwd_pallas(yk, dy, scale=scale, interpret=True)
+        np.testing.assert_allclose(np.asarray(dx), np.asarray(dx_ref),
+                                   atol=1e-5)
+
+    def test_route_rules(self, monkeypatch):
+        """Shape acceptance/rejection logic, with the CPU interpret
+        short-circuit disabled so the rules themselves are exercised."""
+        import apex_tpu.transformer.softmax as sm
+        monkeypatch.setattr(sm, "interpret_default", lambda: False)
+        x = jnp.zeros((2, 4, 8, 16))
+        # accepts: equal dims / megatron (b,1,sq,sk) / all-ones, and
+        # computes the head-broadcast factor for the flattened batch
+        assert sm._pallas_route(x, None, 1.0, True) == (True, 1)
+        assert sm._pallas_route(x, jnp.zeros((2, 4, 8, 16)), 1.0,
+                                False) == (True, 1)
+        assert sm._pallas_route(x, jnp.zeros((2, 1, 8, 16)), 1.0,
+                                False) == (True, 4)
+        assert sm._pallas_route(x, jnp.zeros((1, 1, 8, 16)), 1.0,
+                                False) == (True, 8)
+        assert sm._pallas_route(x, jnp.zeros((2, 1, 1, 16)), 1.0,
+                                False) == (True, 4)
+        # rejects: sq mismatch, non-broadcast lead, sk mismatch, huge rows
+        assert not sm._pallas_route(x, jnp.zeros((2, 4, 3, 16)), 1.0,
+                                    False)[0]
+        assert not sm._pallas_route(x, jnp.zeros((2, 3, 8, 16)), 1.0,
+                                    False)[0]
+        assert not sm._pallas_route(x, jnp.zeros((2, 4, 8, 32)), 1.0,
+                                    False)[0]
+        huge = jax.ShapeDtypeStruct((1, 1, 8, 32768), jnp.float32)
+        assert not sm._pallas_route(huge, None, 1.0, False)[0]
+        # and the short-circuit itself
+        monkeypatch.setattr(sm, "interpret_default", lambda: True)
+        assert not sm._pallas_route(x, None, 1.0, False)[0]
+
+    def test_routed_surface_fwd_bwd_parity(self, monkeypatch):
+        """Execute the actual TPU routing glue (_pallas_softmax custom_vjp,
+        reshape + h wiring behind the public scaled_* functions) by forcing
+        the route open while the kernel itself runs in interpret mode —
+        otherwise this plumbing is only exercised on the real chip."""
+        import apex_tpu.transformer.softmax as sm
+        b, h, sq, sk = 2, 3, 8, 160
+        x = jax.random.normal(jax.random.PRNGKey(11), (b, h, sq, sk))
+        mask = jax.random.bernoulli(jax.random.PRNGKey(12), 0.3,
+                                    (b, 1, sq, sk)).astype(jnp.uint8)
+        dy = jax.random.normal(jax.random.PRNGKey(13), (b, h, sq, sk))
+
+        def run_all():
+            outs = {}
+            for name, fn in [
+                ("masked", lambda x: sm.scaled_masked_softmax(x, mask, 1.4)),
+                ("causal", lambda x: sm.scaled_upper_triang_masked_softmax(
+                    x[..., :sq], 0.9)),
+                ("plain", lambda x: sm.scaled_softmax(x, 2.0)),
+            ]:
+                y, vjp = jax.vjp(fn, x)
+                (dx,) = vjp(dy[..., :y.shape[-1]])
+                outs[name] = (np.asarray(y), np.asarray(dx))
+            return outs
+
+        jnp_path = run_all()  # interpret_default() True → jnp implementation
+        monkeypatch.setattr(sm, "interpret_default", lambda: False)
+        routed = run_all()    # route open; kernel falls to interpret mode
+        for name in jnp_path:
+            np.testing.assert_allclose(routed[name][0], jnp_path[name][0],
+                                       atol=1e-6, err_msg=f"{name} fwd")
+            np.testing.assert_allclose(routed[name][1], jnp_path[name][1],
+                                       atol=1e-5, err_msg=f"{name} bwd")
