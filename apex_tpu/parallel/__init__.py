@@ -1,7 +1,9 @@
 """Distributed utilities — TPU equivalent of the removed ``apex.parallel``
 (DDP + SyncBatchNorm) and the contrib comm machinery, over XLA collectives."""
 
-from apex_tpu.parallel.mesh import get_mesh, make_mesh  # noqa: F401
+from apex_tpu.parallel.mesh import (get_mesh, init_distributed,  # noqa: F401
+                                    make_hybrid_mesh, make_mesh,
+                                    make_topology_mesh)
 from apex_tpu.parallel.ddp import (  # noqa: F401
     DistributedDataParallel,
     bucketed_allreduce,

@@ -1,13 +1,21 @@
-"""Mesh helpers — the rendezvous layer.
+"""Mesh helpers — the rendezvous + fabric layer.
 
 Reference analog: NCCL bootstrap (``apex/contrib/csrc/nccl_p2p/nccl_p2p.cpp:20-22``
-broadcasting ``ncclUniqueId``) and c10d process groups. On TPU the fabric is the
-device mesh: ``jax.sharding.Mesh`` over ICI (+DCN for multislice), with
-``jax.distributed.initialize`` as the multi-host rendezvous.
+broadcasting ``ncclUniqueId``), the c10d process groups every distributed
+component rides on, and the env-var rendezvous of ``torch.distributed``
+(MASTER_ADDR/MASTER_PORT/RANK/WORLD_SIZE — the launch contract of the
+reference's DDP tests, tests/distributed/DDP/ddp_race_condition_test.py).
+
+On TPU the comm fabric is the device mesh: ``jax.sharding.Mesh`` over ICI
+within a slice, with a DCN axis across slices/hosts for multislice jobs, and
+``jax.distributed.initialize`` as the multi-host rendezvous (replacing the
+ncclUniqueId broadcast). Collectives are then XLA ``psum``/``all_gather``/
+``ppermute`` under pjit/shard_map — no communicator objects to manage.
 """
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -15,14 +23,104 @@ import numpy as np
 from jax.sharding import Mesh
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None):
+    """Multi-host rendezvous ≈ the reference's NCCL bootstrap.
+
+    Resolution order for each field: explicit argument → JAX's own env/TPU
+    autodetection → the torch.distributed env contract the reference's
+    launch scripts use (``MASTER_ADDR``/``MASTER_PORT``, ``WORLD_SIZE``,
+    ``RANK``). A single-process run (world size 1 and no coordinator)
+    is a no-op, so the same training script works from a laptop to a pod —
+    the ``torchrun``-compatibility the reference's examples assume.
+
+    Returns ``(process_index, process_count)`` after initialization.
+    """
+    world = num_processes
+    if world is None and os.environ.get("WORLD_SIZE"):
+        world = int(os.environ["WORLD_SIZE"])
+    rank = process_id
+    if rank is None and os.environ.get("RANK"):
+        rank = int(os.environ["RANK"])
+    coord = coordinator_address
+    if coord is None and os.environ.get("MASTER_ADDR"):
+        coord = (os.environ["MASTER_ADDR"] + ":"
+                 + os.environ.get("MASTER_PORT", "1234"))
+
+    # world size 1 short-circuits even with a coordinator set — torchrun
+    # exports MASTER_ADDR for --nproc_per_node=1 too. NOTE: nothing before
+    # this point may touch the backend (jax.devices()/process_count()):
+    # jax.distributed.initialize refuses to run once XLA is initialized.
+    single = world == 1 or (world is None and coord is None)
+    if not single:
+        already = getattr(jax.distributed, "is_initialized", lambda: False)()
+        if not already:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=world,
+                                       process_id=rank)
+    return jax.process_index(), jax.process_count()
+
+
 def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
               devices=None) -> Mesh:
+    """Mesh over an explicit device list (row-major assignment).
+
+    For full-machine meshes on real hardware prefer
+    :func:`make_topology_mesh`, which lets jax's mesh utilities pick an
+    ICI-contiguous device order."""
     devices = devices if devices is not None else jax.devices()
     n = int(np.prod(axis_sizes))
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     arr = np.array(devices[:n]).reshape(tuple(axis_sizes))
     return Mesh(arr, tuple(axis_names))
+
+
+def make_topology_mesh(axis_sizes: Sequence[int],
+                       axis_names: Sequence[str]) -> Mesh:
+    """Topology-aware mesh over ALL devices: axis order maps onto the
+    physical ICI torus so the innermost (most-communicating) axes ride the
+    fastest links — the design rule of the scaling playbook. Falls back to
+    row-major assignment when the backend exposes no topology (CPU mesh in
+    tests)."""
+    from jax.experimental import mesh_utils
+
+    # size errors must propagate (a wrong mesh shape is a user bug, and
+    # create_device_mesh handles topology-less backends itself)
+    arr = mesh_utils.create_device_mesh(tuple(axis_sizes))
+    return Mesh(arr, tuple(axis_names))
+
+
+def make_hybrid_mesh(dcn_axis_sizes: Sequence[int],
+                     ici_axis_sizes: Sequence[int],
+                     axis_names: Sequence[str]) -> Mesh:
+    """Multislice mesh: outer axes over DCN (across slices/hosts), inner
+    axes over ICI (within a slice) — e.g. ``make_hybrid_mesh([4], [2, 4],
+    ["dp", "fsdp", "tp"])`` for 4 slices × 8 chips. The DCN axes MUST be
+    the lowest-bandwidth-demand ones (plain data parallel); everything
+    chatty (tp/sp/ep) stays on ICI. ≈ the reference's hierarchy of
+    intra-node NVLink vs inter-node IB process groups.
+
+    Falls back to a flat row-major mesh when no multislice topology is
+    available (single host, CPU tests)."""
+    from jax.experimental import mesh_utils
+
+    names = tuple(axis_names)
+    sizes = tuple(dcn_axis_sizes) + tuple(ici_axis_sizes)
+    assert len(names) == len(sizes), (names, sizes)
+    # fall back to a flat mesh ONLY when the backend exposes no multislice
+    # topology (CPU tests, single slice) — on real multislice hardware a
+    # sizing error must propagate, not silently put tp/sp across DCN
+    devices = jax.devices()
+    if not hasattr(devices[0], "slice_index"):
+        return make_mesh(sizes, names)
+    # create_hybrid_device_mesh multiplies same-rank shapes elementwise, so
+    # pad each side with ones to place DCN axes outermost, ICI innermost
+    ici_p = (1,) * len(dcn_axis_sizes) + tuple(ici_axis_sizes)
+    dcn_p = tuple(dcn_axis_sizes) + (1,) * len(ici_axis_sizes)
+    arr = mesh_utils.create_hybrid_device_mesh(ici_p, dcn_p)
+    return Mesh(arr, names)
 
 
 def get_mesh(data_axis: str = "data", devices=None) -> Mesh:

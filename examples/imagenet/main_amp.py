@@ -19,7 +19,8 @@ from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models.resnet import ResNet18ish, ResNet50
 from apex_tpu.optimizers.functional import adam_update
-from apex_tpu.parallel import bucketed_allreduce, get_mesh
+from apex_tpu.parallel import (bucketed_allreduce, get_mesh,
+                               init_distributed)
 
 
 def main():
@@ -31,9 +32,13 @@ def main():
     ap.add_argument("--lr", type=float, default=1e-3)
     args = ap.parse_args()
 
+    # multi-host rendezvous: honors MASTER_ADDR/RANK/WORLD_SIZE (the
+    # torchrun contract of the reference example) and is a no-op for
+    # single-process runs
+    rank, nproc = init_distributed()
     mesh = get_mesh("data")
     world = mesh.devices.size
-    print(f"devices: {world}")
+    print(f"process {rank}/{nproc}, devices: {world}")
 
     if args.tiny:
         model = ResNet18ish(num_classes=10, axis_name="data")

@@ -46,3 +46,17 @@ def test_optimizer_state_dict_through_checkpoint(tmp_path):
     opt2.step(g)
     for a, b in zip(opt.parameters, opt2.parameters):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_save_async_overlaps_and_is_durable(tmp_path):
+    """save_async returns before the checkpoint is durable; wait() makes it
+    so and a restore round-trips (the GDS async-save story)."""
+    from apex_tpu.utils import checkpoint as ckpt
+    tree = {"w": jnp.arange(64.0).reshape(8, 8),
+            "b": jnp.ones((8,), jnp.bfloat16)}
+    path = str(tmp_path / "async_ckpt")
+    handle = ckpt.save_async(path, tree)
+    handle.wait()
+    out = ckpt.restore(path, like=tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    assert out["b"].dtype == jnp.bfloat16

@@ -194,3 +194,66 @@ class TestSyncBatchNorm:
         y, _ = bn.apply(v, x, use_running_average=False,
                         mutable=["batch_stats"])
         assert float(np.asarray(y).min()) >= 0.0
+
+
+class TestMeshLayer:
+    """Rendezvous + fabric helpers (nccl_p2p.cpp:20-22 bootstrap analog,
+    torchrun env contract, multislice DCN×ICI meshes)."""
+
+    def test_init_distributed_single_process_noop(self, monkeypatch):
+        from apex_tpu.parallel import init_distributed
+        for var in ("WORLD_SIZE", "RANK", "MASTER_ADDR", "MASTER_PORT"):
+            monkeypatch.delenv(var, raising=False)
+        idx, count = init_distributed()
+        assert idx == 0 and count == 1
+
+    def test_init_distributed_world1_env(self, monkeypatch):
+        """torchrun --nproc_per_node=1 exports MASTER_ADDR too; world size 1
+        must short-circuit regardless (and must not touch
+        jax.distributed.initialize, which refuses post-backend-init)."""
+        from apex_tpu.parallel import init_distributed
+        monkeypatch.setenv("WORLD_SIZE", "1")
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("MASTER_PORT", "29500")
+        monkeypatch.setenv("RANK", "0")
+        idx, count = init_distributed()
+        assert idx == 0 and count == 1
+
+    def test_topology_mesh_size_error_propagates(self):
+        from apex_tpu.parallel import make_topology_mesh
+        with pytest.raises(Exception):
+            make_topology_mesh([3], ["dp"])  # 3 does not divide 8 devices
+
+    def test_topology_mesh_covers_all_devices(self):
+        from apex_tpu.parallel import make_topology_mesh
+        n = len(jax.devices())
+        mesh = make_topology_mesh([2, n // 2], ["dp", "tp"])
+        assert mesh.devices.shape == (2, n // 2)
+        assert len(set(d.id for d in mesh.devices.flat)) == n
+
+    def test_hybrid_mesh_axis_layout(self):
+        """DCN axes outermost, ICI innermost; falls back to row-major on
+        backends without multislice topology (this CPU mesh)."""
+        from apex_tpu.parallel import make_hybrid_mesh
+        n = len(jax.devices())
+        mesh = make_hybrid_mesh([2], [1, n // 2], ["dp", "fsdp", "tp"])
+        assert mesh.axis_names == ("dp", "fsdp", "tp")
+        assert mesh.devices.shape == (2, 1, n // 2)
+        # a psum over every axis must see all devices exactly once
+        assert len(set(d.id for d in mesh.devices.flat)) == n
+
+    def test_hybrid_mesh_runs_collective(self):
+        import functools
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.parallel import make_hybrid_mesh
+        n = len(jax.devices())
+        mesh = make_hybrid_mesh([2], [n // 2], ["dp", "tp"])
+
+        @functools.partial(jax.shard_map, mesh=mesh,
+                           in_specs=P("dp", "tp"), out_specs=P(),
+                           check_vma=False)
+        def total(x):
+            return jax.lax.psum(jnp.sum(x), ("dp", "tp"))
+
+        x = jnp.arange(n * 4.0).reshape(2, (n // 2) * 4)
+        np.testing.assert_allclose(float(total(x)[()]), float(x.sum()))
