@@ -48,6 +48,7 @@ from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
 from apex_tpu.normalization.fused_layer_norm import (fused_layer_norm_affine)
 from apex_tpu.optimizers.functional import adam_update
 from apex_tpu.parallel.ring_attention import ring_self_attention
+from apex_tpu.parallel.ulysses import ulysses_self_attention
 
 _f32 = jnp.float32
 
@@ -141,12 +142,16 @@ def _grad_sync_specs(cfg: GPT2Config) -> Dict[str, Any]:
     }
 
 
-def _block_apply(cfg: GPT2Config, blk, x):
+def _block_apply(cfg: GPT2Config, blk, x, sp_strategy: str = "ring"):
     """One transformer block on a local activation shard (b, s_local, e).
 
     TP: column-parallel q/k/v + row-parallel output with psum over tp;
-    SP: ring attention over sp; EP: when the block carries expert weights
-    ("gate_w"/"w1"/"w2"), the FFN is the expert-parallel MoE over ep.
+    SP: sequence parallelism over sp — ``sp_strategy="ring"`` rotates K/V
+    around the ICI ring (any head count), ``"ulysses"`` re-shards
+    head↔sequence with two all-to-alls (needs local heads divisible by sp;
+    see parallel/ulysses.py for the trade-off); EP: when the block carries
+    expert weights ("gate_w"/"w1"/"w2"), the FFN is the expert-parallel MoE
+    over ep.
     """
     cd = cfg.compute_dtype
     e = cfg.n_embd
@@ -163,8 +168,12 @@ def _block_apply(cfg: GPT2Config, blk, x):
     def heads(t):
         return t.reshape(b, s_local, h_local, d).transpose(0, 2, 1, 3)
 
-    o = ring_self_attention(heads(q), heads(k), heads(v), "sp",
-                            causal=True)
+    if sp_strategy == "ulysses":
+        o = ulysses_self_attention(heads(q), heads(k), heads(v), "sp",
+                                   causal=True)
+    else:
+        o = ring_self_attention(heads(q), heads(k), heads(v), "sp",
+                                causal=True)
     o = o.transpose(0, 2, 1, 3).reshape(b, s_local, h_local * d)
     # row-parallel output projection: partial matmul + psum over tp
     attn = jax.lax.psum(o @ blk["wo"].astype(cd), "tp")
@@ -186,7 +195,8 @@ def _block_apply(cfg: GPT2Config, blk, x):
     return x
 
 
-def _forward_local(cfg: GPT2Config, params, tokens, targets, mask):
+def _forward_local(cfg: GPT2Config, params, tokens, targets, mask,
+                   sp_strategy: str = "ring"):
     """Per-shard forward: tokens (b_local, s_local) on a (dp, tp, sp) mesh."""
     cd = cfg.compute_dtype
     e = cfg.n_embd
@@ -205,7 +215,7 @@ def _forward_local(cfg: GPT2Config, params, tokens, targets, mask):
     b, s_local, _ = x.shape
 
     for blk in params["blocks"]:
-        x = _block_apply(cfg, blk, x)
+        x = _block_apply(cfg, blk, x, sp_strategy)
 
     x = fused_layer_norm_affine(x, params["lnf_w"], params["lnf_b"], e)
     logits = jax.lax.dot_general(x, params["wte"].astype(cd),
@@ -218,15 +228,18 @@ def _forward_local(cfg: GPT2Config, params, tokens, targets, mask):
     return tot / jnp.maximum(cnt, 1.0)
 
 
-def make_train_step(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4):
+def make_train_step(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
+                    sp_strategy: str = "ring"):
     """Returns jitted train_step(params, opt_state, tokens, targets, mask, step)
-    → (params, opt_state, loss). Inputs are FULL arrays; sharding via specs."""
+    → (params, opt_state, loss). Inputs are FULL arrays; sharding via specs.
+    ``sp_strategy``: "ring" or "ulysses" (see _block_apply)."""
     pspecs = param_specs(cfg)
     sync_axes = _grad_sync_specs(cfg)
 
     def local_step(params, m, v, tokens, targets, mask, step):
         def loss_fn(p):
-            return _forward_local(cfg, p, tokens, targets, mask)
+            return _forward_local(cfg, p, tokens, targets, mask,
+                                  sp_strategy)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
 

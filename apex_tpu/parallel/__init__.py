@@ -29,6 +29,7 @@ from apex_tpu.parallel.ring_attention import (  # noqa: F401
     zigzag_shard,
     zigzag_unshard,
 )
+from apex_tpu.parallel.ulysses import ulysses_self_attention  # noqa: F401
 from apex_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
     stack_stage_params,

@@ -172,3 +172,27 @@ class TestPipelineComposed:
             losses.append(float(loss))
         assert losses[-1] < losses[0]
         assert np.isfinite(losses).all()
+
+
+def test_ulysses_strategy_matches_ring():
+    """The composed dp×tp×sp step with sp_strategy='ulysses' computes the
+    same loss trajectory as the ring strategy (same math, different comm).
+    CFG has 8 heads, tp=2 → h_local=4, sp=2 divides it."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    mesh = make_mesh([2, 2, 2], ["dp", "tp", "sp"])
+    tokens, targets, mask = _data()
+
+    losses = {}
+    for strat in ("ring", "ulysses"):
+        p = jax.tree_util.tree_map(lambda x: x, params)
+        st = init_opt_state(p)
+        step_fn = make_train_step(CFG, mesh, lr=3e-3, sp_strategy=strat)
+        ls = []
+        for i in range(3):
+            p, st, loss = step_fn(p, st, tokens, targets, mask,
+                                  jnp.int32(i + 1))
+            ls.append(float(loss))
+        losses[strat] = ls
+    np.testing.assert_allclose(losses["ulysses"], losses["ring"],
+                               rtol=2e-2, atol=2e-3)
+    assert losses["ulysses"][-1] < losses["ulysses"][0]

@@ -197,3 +197,78 @@ class TestRingAttention:
                                    atol=5e-4, rtol=5e-4)
         np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
                                    atol=5e-4, rtol=5e-4)
+
+
+class TestUlysses:
+    """Ulysses all-to-all sequence parallelism vs single-device flash."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        import functools
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.ops.pallas.flash_attention import flash_attention
+        from apex_tpu.parallel import get_mesh, ulysses_self_attention
+
+        mesh = get_mesh("sp")
+        n = len(jax.devices())
+        b, h, s, d = 2, n, n * 16, 64
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(k_, (b, h, s, d), jnp.float32) * 0.3
+                   for k_ in ks)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+            out_specs=P(None, None, "sp"), check_vma=False)
+        def sharded(q, k, v):
+            return ulysses_self_attention(q, k, v, "sp", causal)
+
+        out = sharded(q, k, v)
+        ref = flash_attention(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_grad_matches_full_attention(self):
+        import functools
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.ops.pallas.flash_attention import flash_attention
+        from apex_tpu.parallel import get_mesh, ulysses_self_attention
+
+        mesh = get_mesh("sp")
+        n = len(jax.devices())
+        b, h, s, d = 1, n, n * 8, 64
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = (jax.random.normal(k_, (b, h, s, d), jnp.float32) * 0.3
+                   for k_ in ks)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+            out_specs=P(), check_vma=False)
+        def loss_sharded(q, k, v):
+            o = ulysses_self_attention(q, k, v, "sp", True)
+            return jax.lax.psum(jnp.sum(o.astype(jnp.float32) ** 2), "sp")
+
+        g = jax.grad(lambda q: loss_sharded(q, k, v)[()])(q)
+        g_ref = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, True).astype(jnp.float32) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   atol=5e-5)
+
+    def test_rejects_h_not_divisible(self):
+        import functools
+        from jax.sharding import PartitionSpec as P
+        from apex_tpu.parallel import get_mesh, ulysses_self_attention
+
+        mesh = get_mesh("sp")
+        n = len(jax.devices())
+        if n < 2:
+            pytest.skip("needs >1 device")
+        q = jnp.zeros((1, n - 1, n * 8, 64))
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+            out_specs=P(None, None, "sp"), check_vma=False)
+        def sharded(q):
+            return ulysses_self_attention(q, q, q, "sp", False)
+
+        with pytest.raises(Exception):
+            sharded(q)
