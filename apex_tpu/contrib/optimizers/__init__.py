@@ -13,6 +13,9 @@ from apex_tpu.contrib.optimizers.fp16_optimizer import (  # noqa: F401
 from apex_tpu.contrib.optimizers.fused_adam import (  # noqa: F401
     FusedAdam,
 )
+from apex_tpu.contrib.optimizers.fused_lamb import (  # noqa: F401
+    FusedLAMB,
+)
 from apex_tpu.contrib.optimizers.fused_sgd import (  # noqa: F401
     FusedSGD,
 )

@@ -49,6 +49,26 @@ def revive_state(val, fallback):
         return fallback
 
 
+def checkpoint_counter(val, fallback, cls_name: str):
+    """``revive_state`` for state_dict(): additionally WARNS when the dead-
+    tracer fallback fires, because the host mirror counts TRACED calls, not
+    executions — a re-executed jitted step undercounts and the checkpoint's
+    bias correction goes wrong. Shared by the legacy contrib trio."""
+    out = revive_state(val, fallback)
+    if isinstance(val, jax.core.Tracer) and not isinstance(
+            out, jax.core.Tracer):
+        import warnings
+
+        warnings.warn(
+            f"{cls_name} step counter leaked out of a dead trace; "
+            "state_dict() falls back to the host mirror, which counts "
+            "traced calls (not executions) — checkpoint bias correction "
+            "may be wrong. The persistent-optimizer-under-jit pattern is "
+            "unsupported; construct the optimizer inside the trace or use "
+            "the modern functional API.", RuntimeWarning, stacklevel=3)
+    return out
+
+
 class FusedAdam:
     def __init__(self, params: Any, lr: float = 1e-3,
                  bias_correction: bool = True, betas=(0.9, 0.999),
@@ -197,7 +217,10 @@ class FusedAdam:
         return self.parameters
 
     def state_dict(self):
-        return {"step": revive_state(self._step, self._step_host),
+        """Checkpoint state. ``step`` is exact for the supported eager flow;
+        see :func:`checkpoint_counter` for the dead-tracer fallback."""
+        return {"step": checkpoint_counter(self._step, self._step_host,
+                                           "FusedAdam"),
                 "exp_avg": self.exp_avg, "exp_avg_sq": self.exp_avg_sq}
 
     def load_state_dict(self, sd):

@@ -1,0 +1,196 @@
+"""Deprecated-API contrib FusedLAMB — TPU equivalent of
+``apex/contrib/optimizers/fused_lamb.py`` (frontend of the legacy
+``fused_lamb_cuda.lamb`` kernel; step at :112, global-norm blend at
+:134-146, the single multi-tensor launch at :196-230).
+
+The legacy surface this preserves, completing the deprecated contrib trio
+next to :mod:`fused_adam` / :mod:`fused_sgd`:
+
+- construction-time hyperparameters identical to the reference
+  (``adam_w_mode``, ``grad_averaging``, ``max_grad_norm`` default 1.0,
+  ``eps`` default 1e-6);
+- a GLOBAL gradient-norm clip computed across every parameter before the
+  update — the reference computes per-dtype-list L2 norms and blends them
+  (``sqrt(g32² + g16²)``, reference :134-146); on TPU there is one fused
+  jnp reduction over all leaves, which is the same number;
+- the per-tensor trust-ratio update of ``fused_lamb_cuda``: the update term
+  is bias-corrected Adam direction (+ decoupled or L2 weight decay), and
+  the applied step is ``lr · (‖p‖/‖update‖) · update`` with the ratio
+  defined as 1 when either norm is zero;
+- the deprecated explicit-grads flow shared by this trio:
+  ``step(grads=..., output_params=..., scale=..., found_inf=...)`` —
+  grads handed in explicitly, divided by ``scale`` first, with a
+  low-precision copy of the updated params written out on request.
+
+JAX is functional, so ``step`` RETURNS params (and ``(params,
+output_params)`` when requested) instead of mutating.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.contrib.optimizers.fused_adam import (checkpoint_counter,
+                                                    revive_state)
+from apex_tpu.utils.logging import deprecated_warning
+
+
+class FusedLAMB:
+    def __init__(self, params: Any, lr: float = 1e-3,
+                 bias_correction: bool = True, betas=(0.9, 0.999),
+                 eps: float = 1e-6, weight_decay: float = 0.01,
+                 amsgrad: bool = False, adam_w_mode: bool = True,
+                 grad_averaging: bool = True, set_grad_none: bool = True,
+                 max_grad_norm: float = 1.0):
+        deprecated_warning(
+            "apex_tpu.contrib.optimizers.FusedLAMB is deprecated; use "
+            "apex_tpu.optimizers.FusedLAMB")
+        if amsgrad:
+            raise RuntimeError(
+                "FusedLAMB does not support the AMSGrad variant.")
+        self.parameters = params
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self._step = 0
+        self._step_host = 0  # trace-independent mirror, see revive_state
+        f32 = lambda p: jnp.zeros(p.shape, jnp.float32)  # noqa: E731
+        self.exp_avg = jax.tree_util.tree_map(f32, params)
+        self.exp_avg_sq = jax.tree_util.tree_map(f32, params)
+
+    def step(self, closure=None, grads: Any = None,
+             output_params: Any = None, scale: float = 1.0,
+             grad_norms=None, lr: Optional[float] = None,
+             inv_scale=None, found_inf=False):
+        """Legacy step. ``grads`` handed in explicitly (possibly fp16 with
+        fp32 params — the master flow), divided by ``scale`` before the
+        update; ``grad_norms`` optionally supplies precomputed per-list
+        norms (reference :134-146), otherwise the global norm is computed
+        here. Returns updated params, or ``(params, output_params)`` when
+        low-precision copies are requested. Also accepts the modern
+        ``step(grads, lr=..., inv_scale=..., found_inf=...)`` convention so
+        FP16_Optimizer can wrap this class (see fused_adam.py)."""
+        if closure is not None and not callable(closure):
+            closure, grads = None, closure
+        loss = closure() if closure is not None else None
+        if grads is None:
+            raise ValueError("the deprecated flow passes grads explicitly")
+        if inv_scale is not None:
+            scale = 1.0 / inv_scale
+        # overflow-skipped steps never reach the kernel in the reference, so
+        # the step count must not advance on them (same contract as the
+        # legacy FusedAdam; see that module for the traced-found_inf story)
+        self._step = revive_state(self._step, self._step_host)
+        fi = jnp.asarray(found_inf)
+        static_skip: Optional[bool]  # None = data-dependent
+        if (isinstance(fi, jax.core.Tracer)
+                or isinstance(self._step, jax.core.Tracer)):
+            static_skip = None
+            self._step = self._step + jnp.where(fi, 0, 1)
+            self._step_host += 1
+        elif bool(fi):
+            static_skip = True
+        else:
+            static_skip = False
+            self._step += 1
+            self._step_host = int(self._step)
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        inv = 1.0 / scale if hasattr(scale, "dtype") else 1.0 / float(scale)
+
+        g_leaves = jax.tree_util.tree_leaves(grads)
+        # global grad norm over the UNSCALED grads (reference blends the
+        # per-dtype multi_tensor_l2norm results :144-146); caller-supplied
+        # grad_norms (per-list values) short-circuit the reduction
+        if grad_norms is not None:
+            gn = jnp.asarray(grad_norms, jnp.float32)
+            global_norm = (jnp.sqrt(jnp.sum(gn ** 2)) if gn.ndim > 0
+                           else gn) * inv
+        else:
+            global_norm = jnp.sqrt(sum(
+                jnp.sum((g.astype(jnp.float32) * inv) ** 2)
+                for g in g_leaves))
+        # clip factor folded into the grad scale, as the kernel does with
+        # its (global_grad_norm, max_grad_norm) arguments
+        if self.max_grad_norm > 0:
+            clip = jnp.where(global_norm > self.max_grad_norm,
+                             global_norm / self.max_grad_norm, 1.0)
+        else:
+            clip = jnp.float32(1.0)
+
+        if isinstance(self._step, jax.Array):
+            step_for_bc = jnp.maximum(self._step, 1)
+        else:
+            step_for_bc = max(self._step, 1)
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step_for_bc
+            bc2 = 1.0 - b2 ** step_for_bc
+        else:
+            bc1 = bc2 = 1.0
+        beta3 = (1.0 - b1) if self.grad_averaging else 1.0
+        eps, wd, adamw = self.eps, self.weight_decay, self.adam_w_mode
+        keep = fi
+
+        def upd(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * inv / clip
+            if wd and not adamw:
+                # L2 mode: decay joins the gradient before the moments
+                g32 = g32 + wd * p32
+            m_new = b1 * m + beta3 * g32
+            v_new = b2 * v + (1.0 - b2) * g32 * g32
+            update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if wd and adamw:
+                # AdamW mode: decoupled decay joins the update term
+                update = update + wd * p32
+            w_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(update * update))
+            ratio = jnp.where((w_norm > 0) & (u_norm > 0),
+                              w_norm / u_norm, 1.0)
+            p_new = (p32 - lr * ratio * update).astype(p.dtype)
+            if static_skip is False:
+                return p_new, m_new, v_new
+            return (jnp.where(keep, p, p_new),
+                    jnp.where(keep, m, m_new), jnp.where(keep, v, v_new))
+
+        treedef = jax.tree_util.tree_structure(self.parameters)
+        results = [
+            upd(p, g, m, v) for p, g, m, v in zip(
+                jax.tree_util.tree_leaves(self.parameters), g_leaves,
+                jax.tree_util.tree_leaves(self.exp_avg),
+                jax.tree_util.tree_leaves(self.exp_avg_sq))]
+        self.parameters = jax.tree_util.tree_unflatten(
+            treedef, [r[0] for r in results])
+        self.exp_avg = jax.tree_util.tree_unflatten(
+            treedef, [r[1] for r in results])
+        self.exp_avg_sq = jax.tree_util.tree_unflatten(
+            treedef, [r[2] for r in results])
+
+        if output_params is not None:
+            out = jax.tree_util.tree_map(
+                lambda p, o: p.astype(o.dtype), self.parameters,
+                output_params)
+            if loss is not None:
+                return loss, self.parameters, out
+            return self.parameters, out
+        if loss is not None:
+            return loss, self.parameters
+        return self.parameters
+
+    def state_dict(self):
+        return {"step": checkpoint_counter(self._step, self._step_host,
+                                           "FusedLAMB"),
+                "exp_avg": self.exp_avg, "exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd):
+        self._step = self._step_host = int(sd["step"])
+        self.exp_avg = sd["exp_avg"]
+        self.exp_avg_sq = sd["exp_avg_sq"]

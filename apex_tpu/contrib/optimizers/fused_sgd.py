@@ -132,9 +132,10 @@ class FusedSGD:
         return self.parameters
 
     def state_dict(self):
-        from apex_tpu.contrib.optimizers.fused_adam import revive_state
+        from apex_tpu.contrib.optimizers.fused_adam import checkpoint_counter
         return {"momentum_buffer": self.momentum_buffer,
-                "first": revive_state(self._first, self._first_host)}
+                "first": checkpoint_counter(self._first, self._first_host,
+                                            "FusedSGD")}
 
     def load_state_dict(self, sd):
         self.momentum_buffer = sd["momentum_buffer"]

@@ -233,6 +233,9 @@ def make_train_step(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
     """Returns jitted train_step(params, opt_state, tokens, targets, mask, step)
     → (params, opt_state, loss). Inputs are FULL arrays; sharding via specs.
     ``sp_strategy``: "ring" or "ulysses" (see _block_apply)."""
+    if sp_strategy not in ("ring", "ulysses"):
+        raise ValueError(
+            f"sp_strategy must be 'ring' or 'ulysses', got {sp_strategy!r}")
     pspecs = param_specs(cfg)
     sync_axes = _grad_sync_specs(cfg)
 

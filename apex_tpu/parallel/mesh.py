@@ -46,7 +46,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
     coord = coordinator_address
     if coord is None and os.environ.get("MASTER_ADDR"):
         coord = (os.environ["MASTER_ADDR"] + ":"
-                 + os.environ.get("MASTER_PORT", "1234"))
+                 + os.environ.get("MASTER_PORT", "29500"))
 
     # world size 1 short-circuits even with a coordinator set — torchrun
     # exports MASTER_ADDR for --nproc_per_node=1 too. NOTE: nothing before

@@ -30,14 +30,34 @@ def save(path: str, tree: Any) -> None:
 
 class AsyncSaveHandle:
     """Handle for an in-flight async save; ``wait()`` blocks until the
-    checkpoint is durable, then releases the writer."""
+    checkpoint is durable, then releases the writer. ``wait()`` is
+    idempotent; a handle dropped without ``wait()`` warns at collection
+    time (the checkpoint on disk may be partial)."""
 
-    def __init__(self, ckptr):
+    def __init__(self, ckptr, path: str):
         self._ckptr = ckptr
+        self._path = path
+        self._done = False
 
     def wait(self) -> None:
+        if self._done:
+            return
+        self._done = True
         self._ckptr.wait_until_finished()
         self._ckptr.close()
+
+    def __del__(self):
+        if not self._done:
+            import warnings
+
+            warnings.warn(
+                f"AsyncSaveHandle for {self._path!r} was never wait()ed — "
+                "the checkpoint may be incomplete on disk",
+                RuntimeWarning, stacklevel=2)
+            try:
+                self.wait()
+            except Exception:
+                pass
 
 
 def save_async(path: str, tree: Any) -> AsyncSaveHandle:
@@ -52,7 +72,7 @@ def save_async(path: str, tree: Any) -> AsyncSaveHandle:
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, tree, force=True)
-    return AsyncSaveHandle(ckptr)
+    return AsyncSaveHandle(ckptr, path)
 
 
 def restore(path: str, like: Optional[Any] = None) -> Any:

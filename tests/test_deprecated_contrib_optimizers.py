@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from apex_tpu.contrib.optimizers import FusedAdam, FusedSGD
+from apex_tpu.contrib.optimizers import FusedAdam, FusedLAMB, FusedSGD
 
 
 def _np(x):
@@ -102,6 +102,58 @@ class TestDeprecatedFusedSGD:
         # g/scale = 2; wd after: g += 0.1*2 = 2.2; p = 2 - 0.5*2.2
         np.testing.assert_allclose(_np(params[0]), 2.0 - 0.5 * 2.2,
                                    rtol=1e-6)
+
+
+class TestDeprecatedFusedLAMB:
+    """Parity of the legacy contrib FusedLAMB (explicit-grads flow) vs the
+    modern apex_tpu.optimizers.FusedLAMB (tree path) — same math chain:
+    global-norm clip, Adam direction, per-tensor trust ratio
+    (reference apex/contrib/optimizers/fused_lamb.py:112-230)."""
+
+    @pytest.mark.parametrize("adam_w_mode", [True, False])
+    def test_parity_vs_modern(self, adam_w_mode):
+        from apex_tpu.optimizers import FusedLAMB as ModernLAMB
+        p = [jax.random.normal(jax.random.PRNGKey(0), (33,), jnp.float32),
+             jax.random.normal(jax.random.PRNGKey(1), (5, 9), jnp.float32)]
+        gs = [[jax.random.normal(jax.random.PRNGKey(10 * s + i), leaf.shape,
+                                 jnp.float32)
+               for i, leaf in enumerate(p)] for s in range(3)]
+        kw = dict(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0,
+                  adam_w_mode=adam_w_mode)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            legacy = FusedLAMB(p, **kw)
+        modern = ModernLAMB(p, use_flat=False, **kw)
+        for g in gs:
+            got = legacy.step(grads=g)
+            want = modern.step(g)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(_np(a), _np(b), rtol=2e-5, atol=2e-6)
+
+    def test_scale_divisor_and_output_params(self):
+        p = [jnp.ones((16,), jnp.float32)]
+        g = [jnp.full((16,), 0.5, jnp.float32)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ref = FusedLAMB([p[0]], lr=0.1)
+            want = ref.step(grads=g)
+            opt = FusedLAMB(p, lr=0.1)
+            params, out = opt.step(grads=[g[0] * 8.0], scale=8.0,
+                                   output_params=[jnp.zeros((16,),
+                                                            jnp.bfloat16)])
+        np.testing.assert_allclose(_np(params[0]), _np(want[0]), rtol=1e-6)
+        assert out[0].dtype == jnp.bfloat16
+
+    def test_found_inf_skips_step(self):
+        p = [jnp.ones((8,), jnp.float32)]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedLAMB(p, lr=0.1)
+        got = opt.step(grads=[jnp.ones((8,))], found_inf=jnp.bool_(True))
+        np.testing.assert_array_equal(_np(got[0]), 1.0)
+        assert opt.state_dict()["step"] == 0
+        opt.step(grads=[jnp.ones((8,))])
+        assert opt.state_dict()["step"] == 1
 
 
 class TestLoggingUtils:
