@@ -41,8 +41,13 @@ class Policy:
                        loss_scale: Union[None, float, str] = None) -> "Policy":
         ol = opt_level.upper()
         if ol == "O0":
+            # fp32 end to end; an explicit loss_scale is still honored
+            # (the reference L1 matrix runs O0 with --loss-scale 1/128/
+            # dynamic — scaling fp32 grads is a semantic no-op but the
+            # machinery must run, run_test.sh:29-49)
             return cls(ol, jnp.float32, jnp.float32, jnp.float32,
-                       True, None, False)
+                       True if keep_batchnorm_fp32 is None
+                       else keep_batchnorm_fp32, loss_scale, False)
         if ol == "O1":
             return cls(ol, jnp.float32, low_dtype, jnp.float32,
                        True if keep_batchnorm_fp32 is None

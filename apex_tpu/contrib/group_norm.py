@@ -4,11 +4,13 @@ stack: ``group_norm_cuda`` one/two-pass (27 instantiation files),
 ``apex/contrib/group_norm/group_norm.py`` (:211 module, algorithm selection
 :193-209, ``torch_group_norm`` fallback :37).
 
-TPU design: one implementation for all channel counts — XLA fuses the
-reduction + normalize + SiLU chain over the NHWC layout (the layout TPU convs
-prefer, same reason the reference targets NHWC). Stats always fp32. The
-reference's one-pass/two-pass/v2 algorithm switch and SUPPORTED_CHANNELS
-tables (:247-325) are compiler concerns on TPU and intentionally absent.
+TPU design: one kernel pair covers all channel counts (no SUPPORTED_CHANNELS
+tables, :247-325 — per-shape instantiation is Mosaic's job), but the
+reference's one-pass/two-pass ALGORITHM switch survives, translated: the
+one-pass Pallas kernel normalizes on a single HBM read of x when the sample
+slab fits VMEM, else the tiled two-pass pair runs (selection in
+ops/pallas/group_norm_kernel.py:one_pass_ok ≈ group_norm.py:193-209).
+Stats always fp32.
 """
 
 from __future__ import annotations
@@ -41,20 +43,20 @@ def _gn_jnp(x, num_groups, weight, bias, eps, act):
     return y.astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 4, 5))
-def _gn_pallas(x, num_groups, weight, bias, eps, act):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 4, 5, 6))
+def _gn_pallas(x, num_groups, weight, bias, eps, act, algo):
     y, _, _ = _gnk.group_norm_nhwc_pallas(x, num_groups, weight, bias, eps,
-                                          act)
+                                          act, algo=algo)
     return y
 
 
-def _gn_pallas_fwd(x, num_groups, weight, bias, eps, act):
+def _gn_pallas_fwd(x, num_groups, weight, bias, eps, act, algo):
     y, mean, rstd = _gnk.group_norm_nhwc_pallas(x, num_groups, weight, bias,
-                                                eps, act)
+                                                eps, act, algo=algo)
     return y, (x, weight, bias, mean, rstd)
 
 
-def _gn_pallas_bwd(num_groups, eps, act, res, dy):
+def _gn_pallas_bwd(num_groups, eps, act, algo, res, dy):
     """Analytic GN backward from saved (mean, rstd) — one fused XLA chain
     (the reference ships dedicated bwd kernels; the dgamma/dbeta column
     reductions are XLA's bread and butter)."""
@@ -100,16 +102,23 @@ _gn_pallas.defvjp(_gn_pallas_fwd, _gn_pallas_bwd)
 def group_norm_nhwc(x: jax.Array, num_groups: int,
                     weight: Optional[jax.Array] = None,
                     bias: Optional[jax.Array] = None, eps: float = 1e-5,
-                    act: str = "") -> jax.Array:
+                    act: str = "", algo: str = "auto") -> jax.Array:
     """x: (N, H, W, C); ``act`` in {"", "silu"} (the fused SiLU epilogue of
-    group_norm_nhwc_one_pass_*.cu). Dispatches to the Pallas two-pass kernel
-    pair when shapes are tile-friendly, else the jnp path."""
+    group_norm_nhwc_one_pass_*.cu). Dispatches to the Pallas one-pass kernel
+    when the sample slab fits VMEM, the tiled two-pass pair otherwise
+    (``algo`` overrides — the reference's selection knob,
+    group_norm.py:193-209), and the jnp path for tile-unfriendly shapes."""
     n, h, w, c = x.shape
     assert c % num_groups == 0
     if act not in ("", "silu"):
         raise ValueError(f"unsupported act {act!r}")
     if _gnk.pallas_ok(n, h * w, c):
-        return _gn_pallas(x, num_groups, weight, bias, eps, act)
+        return _gn_pallas(x, num_groups, weight, bias, eps, act, algo)
+    if algo != "auto":
+        # an explicit algorithm request must not silently run the jnp path
+        raise ValueError(
+            f"algo={algo!r} requested but the Pallas kernels need HW % 8 "
+            f"== 0 (got {h}x{w}); use algo='auto' for the jnp fallback")
     return _gn_jnp(x, num_groups, weight, bias, eps, act)
 
 

@@ -3,12 +3,23 @@ equivalent of ``group_norm_cuda`` / ``group_norm_v2_cuda``
 (apex/contrib/csrc/group_norm*: one-pass & two-pass NHWC algorithms across 27
 per-channel-count instantiations; SURVEY §2.3).
 
-TPU design: the two-pass structure survives (pass 1: per-(sample, group)
-sum/sumsq partials accumulated across HW tiles; pass 2: normalize + affine +
-SiLU fused over the same tiles) but ONE kernel pair covers every channel
-count — per-shape instantiation is the Mosaic compiler's job. Stats fp32.
-The backward uses the saved (mean, rstd) in one fused XLA chain (the
-dgamma/dbeta reductions are column sums XLA already tiles well).
+TPU design: BOTH reference algorithms, selected like the reference selects
+them (``group_norm.py:193-209`` keys one-pass on channels-per-group and SM
+resources; here the analogous resource bound is the VMEM slab):
+
+- **one-pass** (``_one_pass_kernel``): the whole (HW, C) sample slab lives
+  in VMEM for one grid step — stats AND normalize+affine+SiLU happen on a
+  single HBM read of x (1R + 1W total), halving traffic exactly where the
+  reference's one-pass wins. Selected when the slab fits
+  (:func:`one_pass_ok`).
+- **two-pass** (``_stats_kernel`` + ``_apply_kernel``): per-(sample, group)
+  sum/sumsq partials accumulated across HW tiles, then a second sweep
+  normalizes (2R + 1W) — covers arbitrarily large HW.
+
+ONE kernel pair covers every channel count — per-shape instantiation is the
+Mosaic compiler's job. Stats fp32. The backward uses the saved (mean, rstd)
+in one fused XLA chain (the dgamma/dbeta reductions are column sums XLA
+already tiles well).
 """
 
 from __future__ import annotations
@@ -31,6 +42,20 @@ def pallas_ok(n: int, hw: int, c: int) -> bool:
     return hw % 8 == 0
 
 
+# one-pass slab budget: the (hw, c) block is double-buffered by Mosaic for
+# BOTH x and y (4 windows) plus the in-kernel fp32 temporaries — a 2 MiB
+# fp32 payload bounds the worst case (~10 MiB) under the ~16 MiB VMEM.
+_ONE_PASS_SLAB_ELEMS = (2 * 1024 * 1024) // 4
+
+
+def one_pass_ok(n: int, hw: int, c: int) -> bool:
+    """TPU translation of the reference's one-pass eligibility rule
+    (apex/contrib/group_norm/group_norm.py:193-209 picks one-pass by
+    channels-per-group / SM capacity): one-pass needs the full per-sample
+    (HW, C) slab resident so stats and apply share one read of x."""
+    return pallas_ok(n, hw, c) and hw * c <= _ONE_PASS_SLAB_ELEMS
+
+
 def _pick_hw_block(hw: int, c: int) -> int:
     budget = max((2 * 1024 * 1024) // max(c * 4, 1), 8)
     blk = 1 << (budget.bit_length() - 1)
@@ -38,6 +63,38 @@ def _pick_hw_block(hw: int, c: int) -> int:
     while hw % blk != 0 and blk > 8:
         blk //= 2
     return max(blk, 8)
+
+
+def _make_sel(c: int, g: int):
+    """(C, G) one-hot group-selector matrix (contiguous groups)."""
+    return (jax.lax.broadcasted_iota(jnp.int32, (c, g), 0) // (c // g)
+            == jax.lax.broadcasted_iota(jnp.int32, (c, g), 1)).astype(_f32)
+
+
+def _append_wb(in_specs, args, weight, bias, c, wspec):
+    """Append the optional affine operands (shared by both drivers)."""
+    if weight is not None:
+        in_specs.append(wspec)
+        args.append(weight.reshape(1, c))
+    if bias is not None:
+        in_specs.append(wspec)
+        args.append(bias.reshape(1, c))
+
+
+def _split_wb(refs, n_head: int, has_w: bool, has_b: bool):
+    """Split *refs laid out as [head..., w?, b?, tail...] →
+    (head_refs, w_ref, b_ref, tail_refs) — the single unpacking convention
+    for both drivers' kernels."""
+    head = refs[:n_head]
+    idx = n_head
+    w_ref = b_ref = None
+    if has_w:
+        w_ref = refs[idx]
+        idx += 1
+    if has_b:
+        b_ref = refs[idx]
+        idx += 1
+    return head, w_ref, b_ref, refs[idx:]
 
 
 def _stats_kernel(x_ref, sel_ref, sum_ref, sq_ref):
@@ -76,15 +133,108 @@ def _apply_kernel(x_ref, mean_ref, rstd_ref, w_ref, b_ref, y_ref, *,
     y_ref[0] = y.astype(y_ref.dtype)
 
 
+def _one_pass_kernel(x_ref, sel_ref, selt_ref, w_ref, b_ref,
+                     y_ref, mean_ref, rstd_ref, *, act: str, eps: float,
+                     cnt: float):
+    """Whole-sample slab: stats + normalize + affine + activation on ONE
+    read of x (the reference's one-pass structure,
+    group_norm_nhwc_one_pass_*.cu)."""
+    x = x_ref[0].astype(_f32)                     # (hw, C)
+    sel = sel_ref[...]                            # (C, G) one-hot
+    csum = jnp.sum(x, axis=0, keepdims=True)      # (1, C)
+    csq = jnp.sum(x * x, axis=0, keepdims=True)
+    # HIGHEST precision — same rationale as _stats_kernel
+    gsum = jnp.dot(csum, sel, preferred_element_type=_f32,
+                   precision=jax.lax.Precision.HIGHEST)      # (1, G)
+    gsq = jnp.dot(csq, sel, preferred_element_type=_f32,
+                  precision=jax.lax.Precision.HIGHEST)
+    mean = gsum / cnt
+    var = gsq / cnt - mean * mean
+    rstd = jax.lax.rsqrt(var + eps)
+    mean_ref[0] = mean
+    rstd_ref[0] = rstd
+    selt = selt_ref[...]                          # (G, C) one-hot
+    # HIGHEST: default (bf16-operand) precision would round the fp32 group
+    # stats to ~2^-9 relative before normalization (same hazard as the
+    # stats dots above)
+    mean_c = jnp.dot(mean, selt, preferred_element_type=_f32,
+                     precision=jax.lax.Precision.HIGHEST)     # (1, C)
+    rstd_c = jnp.dot(rstd, selt, preferred_element_type=_f32,
+                     precision=jax.lax.Precision.HIGHEST)
+    y = (x - mean_c) * rstd_c
+    if w_ref is not None:
+        y = y * w_ref[...].astype(_f32)
+    if b_ref is not None:
+        y = y + b_ref[...].astype(_f32)
+    if act == "silu":
+        y = y * jax.nn.sigmoid(y)
+    y_ref[0] = y.astype(y_ref.dtype)
+
+
+def _group_norm_one_pass(x3, n, hw, c, g, weight, bias, eps, act,
+                         interpret):
+    sel = _make_sel(c, g)
+    xspec = pl.BlockSpec((1, hw, c), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    gspec = pl.BlockSpec((1, 1, g), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM)
+    wspec = pl.BlockSpec((1, c), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM)
+    in_specs = [xspec,
+                pl.BlockSpec((c, g), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((g, c), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM)]
+    args = [x3, sel, sel.T]
+    _append_wb(in_specs, args, weight, bias, c, wspec)
+
+    def kernel(*refs):
+        (x_ref, s_ref, st_ref), w_ref, b_ref, tail = _split_wb(
+            refs, 3, weight is not None, bias is not None)
+        y_ref, m_ref, r_ref = tail
+        _one_pass_kernel(x_ref, s_ref, st_ref, w_ref, b_ref,
+                         y_ref, m_ref, r_ref, act=act, eps=eps,
+                         cnt=float(hw * (c // g)))
+
+    y, mean, rstd = pl.pallas_call(
+        kernel,
+        grid=(n,),
+        in_specs=in_specs,
+        out_specs=[xspec, gspec, gspec],
+        out_shape=[jax.ShapeDtypeStruct((n, hw, c), x3.dtype),
+                   jax.ShapeDtypeStruct((n, 1, g), _f32),
+                   jax.ShapeDtypeStruct((n, 1, g), _f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(*args)
+    return y, mean[:, 0], rstd[:, 0]
+
+
 def group_norm_nhwc_pallas(x: jax.Array, num_groups: int,
                            weight: Optional[jax.Array] = None,
                            bias: Optional[jax.Array] = None,
                            eps: float = 1e-5, act: str = "",
-                           interpret: Optional[bool] = None):
-    """Forward: returns (y, mean, rstd) with mean/rstd (N, G) fp32."""
+                           interpret: Optional[bool] = None,
+                           algo: str = "auto"):
+    """Forward: returns (y, mean, rstd) with mean/rstd (N, G) fp32.
+
+    ``algo``: "auto" (one-pass when the sample slab fits VMEM — the
+    reference's selection rule translated, group_norm.py:193-209),
+    "one_pass", or "two_pass"."""
     if interpret is None:
         interpret = interpret_default()
     n, h, w, c = x.shape
+    if algo == "auto":
+        algo = "one_pass" if one_pass_ok(n, h * w, c) else "two_pass"
+    elif algo not in ("one_pass", "two_pass"):
+        raise ValueError(f"algo must be auto|one_pass|two_pass, got {algo!r}")
+    if algo == "one_pass":
+        g = num_groups
+        y, mean, rstd = _group_norm_one_pass(
+            x.reshape(n, h * w, c), n, h * w, c, g, weight, bias, eps, act,
+            interpret)
+        return y.reshape(n, h, w, c), mean, rstd
     g = num_groups
     hw = h * w
     x3 = x.reshape(n, hw, c)
@@ -98,8 +248,7 @@ def group_norm_nhwc_pallas(x: jax.Array, num_groups: int,
     selspec = pl.BlockSpec((c, g), lambda i, j: (0, 0),
                            memory_space=pltpu.VMEM)
     cpg = c // g
-    sel = (jax.lax.broadcasted_iota(jnp.int32, (c, g), 0) // cpg
-           == jax.lax.broadcasted_iota(jnp.int32, (c, g), 1)).astype(_f32)
+    sel = _make_sel(c, g)
 
     sums, sqs = pl.pallas_call(
         _stats_kernel,
@@ -124,23 +273,12 @@ def group_norm_nhwc_pallas(x: jax.Array, num_groups: int,
     args = [x3, mean_c, rstd_c]
     wspec = pl.BlockSpec((1, c), lambda i, j: (0, 0),
                          memory_space=pltpu.VMEM)
-    if weight is not None:
-        in_specs.append(wspec)
-        args.append(weight.reshape(1, c))
-    if bias is not None:
-        in_specs.append(wspec)
-        args.append(bias.reshape(1, c))
+    _append_wb(in_specs, args, weight, bias, c, wspec)
 
     def kernel(*refs):
-        if weight is not None and bias is not None:
-            x_ref, m_ref, r_ref, w_ref, b_ref, y_ref = refs
-        elif weight is not None:
-            x_ref, m_ref, r_ref, w_ref, y_ref = refs
-            b_ref = None
-        else:
-            x_ref, m_ref, r_ref, y_ref = refs
-            w_ref = b_ref = None
-        _apply_kernel(x_ref, m_ref, r_ref, w_ref, b_ref, y_ref, act=act)
+        (x_ref, m_ref, r_ref), w_ref, b_ref, tail = _split_wb(
+            refs, 3, weight is not None, bias is not None)
+        _apply_kernel(x_ref, m_ref, r_ref, w_ref, b_ref, tail[0], act=act)
 
     y = pl.pallas_call(
         kernel,

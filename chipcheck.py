@@ -284,17 +284,19 @@ def check_group_norm(jax, jnp):
     bs = 0.1 * jax.random.normal(jax.random.PRNGKey(2), (c,))
     errs, oks = [], []
     for act in ("", "silu"):
-        y, mean, rstd = group_norm_nhwc_pallas(x, g, wt, bs, act=act)
-        x5 = x.reshape(n, h * w_, g, c // g).astype(jnp.float32)
-        mu = jnp.mean(x5, axis=(1, 3), keepdims=True)
-        var = jnp.mean((x5 - mu) ** 2, axis=(1, 3), keepdims=True)
-        yr = ((x5 - mu) / jnp.sqrt(var + 1e-5)).reshape(n, h, w_, c)
-        yr = yr * wt + bs
-        if act == "silu":
-            yr = yr * jax.nn.sigmoid(yr)
-        e, ok = _cmp(y, yr, 1e-4)
-        errs.append(e)
-        oks.append(ok)
+        for algo in ("one_pass", "two_pass"):
+            y, mean, rstd = group_norm_nhwc_pallas(x, g, wt, bs, act=act,
+                                                   algo=algo)
+            x5 = x.reshape(n, h * w_, g, c // g).astype(jnp.float32)
+            mu = jnp.mean(x5, axis=(1, 3), keepdims=True)
+            var = jnp.mean((x5 - mu) ** 2, axis=(1, 3), keepdims=True)
+            yr = ((x5 - mu) / jnp.sqrt(var + 1e-5)).reshape(n, h, w_, c)
+            yr = yr * wt + bs
+            if act == "silu":
+                yr = yr * jax.nn.sigmoid(yr)
+            e, ok = _cmp(y, yr, 1e-4)
+            errs.append(e)
+            oks.append(ok)
     return {"max_err": max(errs), "pass": all(oks)}
 
 

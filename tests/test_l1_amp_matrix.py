@@ -64,15 +64,18 @@ def _train(opt_level, loss_scale, keep_bn_fp32, steps=STEPS, lr=1e-3,
     return losses, p
 
 
+# The FULL reference matrix (tests/L1/common/run_test.sh:29-49): every
+# opt-level × loss-scale × keep-bn cell, with the reference's own skip rule
+# (O1 + an explicit keep_batchnorm flag is skipped, run_test.sh:67-71) —
+# 40 cells, no sampling.
 MATRIX = [
     (ol, ls, bn)
     for ol in ("O0", "O1", "O2", "O3")
     for ls in (None, 1.0, 128.0, "dynamic")
     for bn in (None, True, False)
-    # trim: bn flag only meaningful off-O0; sample the cross product the way
-    # run_test.sh does rather than all 48 cells
-    if not (ol == "O0" and (ls is not None or bn is not None))
-][:20]
+    if not (ol == "O1" and bn is not None)
+]
+assert len(MATRIX) == 40
 
 
 class TestAmpMatrix:

@@ -1,0 +1,107 @@
+"""Flash-attention block-size sweep on the real chip (VERDICT r2 item 2).
+
+Measures fwd and fwd+bwd TFLOPs of ops/pallas/flash_attention.py across
+(block_q, block_k) configurations at the bench shape (b4·h16·s2048·d64,
+bf16, causal) plus a d=128 reference point, printing one JSON line per
+config AS IT COMPLETES (python -u; the relay can die mid-sweep and earlier
+lines survive). Run unbounded in the background — never under `timeout`
+(killing a TPU-holding process wedges the relay).
+
+    nohup python -u tools/tune_flash.py > tools/tune_flash.out 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from bench import wait_for_backend
+
+    if not wait_for_backend(tag="tune_flash"):
+        print(json.dumps({"error": "backend unreachable"}))
+        sys.exit(2)
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.pallas.flash_attention import flash_attention
+    from apex_tpu.utils.benchtime import measure_fetch_floor, timed_steps
+
+    backend = jax.default_backend()
+    print(f"# backend={backend}", flush=True)
+    on_tpu = backend == "tpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peak = {"v5e": 197.0, "v6e": 918.0, "v5p": 459.0}.get(gen, 197.0)
+    floor_s = measure_fetch_floor()
+
+    def measure(b, h, s, d, bq, bk, iters):
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(k_, (b, h, s, d), jnp.bfloat16) * 0.2
+                   for k_ in ks)
+
+        def fwd_step(i, q, k, v):
+            return flash_attention(q, k, v, True, block_q=bq,
+                                   block_k=bk).astype(q.dtype)
+
+        ms_fwd = timed_steps(fwd_step, q, iters=iters, consts=(k, v),
+                             floor_s=floor_s, donate=False)
+
+        gradfn = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True, block_q=bq,
+                            block_k=bk).astype(jnp.float32) ** 2))
+
+        def bwd_step(i, q, k, v):
+            return (q + 1e-3 * gradfn(q, k, v).astype(q.dtype)) \
+                .astype(q.dtype)
+
+        ms_fb = timed_steps(bwd_step, q, iters=iters, consts=(k, v),
+                            floor_s=floor_s, donate=False)
+
+        flops_fwd = 2 * 2 * b * h * s * s * d / 2  # causal
+        # bwd ≈ 2.5x fwd FLOPs (dq, dk, dv + recompute); fwd+bwd total 3.5x
+        tflops_fwd = flops_fwd / (ms_fwd / 1e3) / 1e12
+        tflops_fb = 3.5 * flops_fwd / (ms_fb / 1e3) / 1e12
+        return {"shape": f"b{b}h{h}s{s}d{d}", "bq": bq, "bk": bk,
+                "fwd_ms": round(ms_fwd, 3), "fwd_tflops": round(tflops_fwd, 1),
+                "fwd_mxu": round(tflops_fwd / peak, 3),
+                "fb_ms": round(ms_fb, 3), "fb_tflops": round(tflops_fb, 1),
+                "fb_mxu": round(tflops_fb / peak, 3)}
+
+    b, h, s, d = (4, 16, 2048, 64) if on_tpu else (1, 2, 256, 64)
+    iters = 20 if on_tpu else 2
+    blocks = ([(256, 256), (256, 512), (512, 512), (512, 1024),
+               (1024, 512), (1024, 1024), (2048, 512), (512, 2048),
+               (1024, 2048), (2048, 1024), (2048, 2048), (256, 2048)]
+              if on_tpu else [(128, 128), (256, 128)])
+    best = None
+    for bq, bk in blocks:
+        if bq > s or bk > s:
+            continue
+        try:
+            t0 = time.perf_counter()
+            r = measure(b, h, s, d, bq, bk, iters)
+            r["wall_s"] = round(time.perf_counter() - t0, 1)
+            print(json.dumps(r), flush=True)
+            if best is None or r["fwd_tflops"] > best["fwd_tflops"]:
+                best = r
+        except Exception as e:
+            print(json.dumps({"bq": bq, "bk": bk,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+    if on_tpu and best is not None:
+        # d=128 reference point at the winning blocks
+        try:
+            r = measure(4, 8, 2048, 128, best["bq"], best["bk"], iters)
+            print(json.dumps(r), flush=True)
+        except Exception as e:
+            print(json.dumps({"shape": "d128", "error": str(e)}), flush=True)
+    print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
