@@ -451,10 +451,23 @@ def bench_gpt2_fwd(jax, jnp, on_tpu, chip, floor_s):
     }
 
 
-def main():
-    jax, backend = _backend_with_timeout()
-    import jax.numpy as jnp
+BENCHES = [("fused_adam_1b", bench_fused_adam),
+           ("layer_norm", bench_layer_norm),
+           ("flash_attention", bench_flash_attention),
+           ("softmax_rope", bench_softmax_rope),
+           ("resnet50_train", bench_resnet50),
+           ("bert_lamb", bench_bert_lamb),
+           ("gpt2_fwd", bench_gpt2_fwd)]
 
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CACHE = os.path.join(_HERE, "BENCH_TPU_CACHE.json")
+
+
+def run_suite(jax, jnp, backend: str, out_path: str | None = None) -> dict:
+    """Run every bench against an ALREADY-initialized backend. The suite
+    dict is rewritten to ``out_path`` after each bench so a mid-run crash
+    (or relay death) still leaves a partial artifact on disk. Callable from
+    the background chip worker (tools/chip_worker.py) without re-probing."""
     from apex_tpu.utils.benchtime import measure_fetch_floor
 
     on_tpu = backend == "tpu"
@@ -462,34 +475,46 @@ def main():
     chip = _CHIP.get(gen, _CHIP["v5e"])
     floor_s = measure_fetch_floor()
 
+    try:
+        git = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                             cwd=_HERE, capture_output=True, text=True,
+                             timeout=10).stdout.strip()
+    except Exception:
+        git = "unknown"
     suite = {"backend": backend, "chip": gen if on_tpu else "cpu-smoke",
-             "fetch_floor_ms": round(floor_s * 1e3, 1)}
-    headline = None
-    benches = [("fused_adam_1b", bench_fused_adam),
-               ("layer_norm", bench_layer_norm),
-               ("flash_attention", bench_flash_attention),
-               ("softmax_rope", bench_softmax_rope),
-               ("resnet50_train", bench_resnet50),
-               ("bert_lamb", bench_bert_lamb),
-               ("gpt2_fwd", bench_gpt2_fwd)]
-    for name, fn in benches:
+             "fetch_floor_ms": round(floor_s * 1e3, 1),
+             "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
+             "git": git, "complete": False}
+
+    def flush():
+        if out_path is not None:  # atomic: a concurrent reader (bench.py
+            tmp = out_path + ".tmp"  # polling the cache) must never see a
+            with open(tmp, "w") as f:  # half-written file
+                json.dump(suite, f, indent=1)
+            os.replace(tmp, out_path)
+
+    flush()
+    for name, fn in BENCHES:
         try:
             t0 = time.perf_counter()
             entry = fn(jax, jnp, on_tpu, chip, floor_s)
             entry["bench_wall_s"] = round(time.perf_counter() - t0, 1)
             suite[name] = entry
-            print(f"[bench] {name}: {entry}", file=sys.stderr)
-        except Exception as e:  # a failing sub-bench must not kill the line
+            print(f"[bench] {name}: {entry}", file=sys.stderr, flush=True)
+        except Exception as e:  # a failing sub-bench must not kill the suite
             suite[name] = {"error": f"{type(e).__name__}: {e}"}
-            print(f"[bench] {name} FAILED: {e}", file=sys.stderr)
-        if name == "fused_adam_1b" and "error" not in suite[name]:
-            headline = suite[name]
+            print(f"[bench] {name} FAILED: {e}", file=sys.stderr, flush=True)
+        flush()
+    suite["complete"] = True
+    flush()
+    return suite
 
-    here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "BENCH_SUITE.json"), "w") as f:
-        json.dump(suite, f, indent=1)
 
-    if headline is None:  # headline failed: emit an honest failure line
+def _emit(suite, cached: bool) -> None:
+    """Print the one-line headline record and exit accordingly."""
+    backend = suite.get("backend", "unknown")
+    headline = suite.get("fused_adam_1b")
+    if not isinstance(headline, dict) or "error" in headline:
         headline = {"metric": "fused_adam_step_ms", "value": -1.0,
                     "unit": "ms", "vs_baseline": 0.0}
     line = {k: headline[k] for k in
@@ -497,11 +522,119 @@ def main():
     # the backend is part of the record: a CPU-smoke capture must be
     # unmistakable AND fail the run (rounds 1-2 shipped silent cpu rc=0)
     line["backend"] = backend
+    if cached:
+        line["cached"] = True
+        line["captured"] = suite.get("captured")
     print(json.dumps(line))
     if backend != "tpu":
         print("[bench] FAILED to reach the TPU — this is a CPU smoke "
               "record, not an acceptance artifact", file=sys.stderr)
         sys.exit(3)
+    sys.exit(0)
+
+
+def _load_cache(require_complete: bool = True, max_age_h: float = 24.0):
+    """Return the TPU capture if it is usable, else None. ``max_age_h``
+    rejects captures from a previous round (the driver restarts rounds on a
+    ~12 h cadence; a stale committed cache must not mask a regression —
+    the 'captured' stamp and 'git' rev are also carried into the emitted
+    headline so the record is auditable)."""
+    try:
+        with open(_CACHE) as f:
+            suite = json.load(f)
+    except Exception:
+        return None
+    if suite.get("backend") != "tpu":
+        return None
+    if require_complete and not suite.get("complete"):
+        return None
+    if not isinstance(suite.get("fused_adam_1b"), dict) or \
+            "error" in suite["fused_adam_1b"]:
+        return None
+    try:
+        age_s = time.time() - time.mktime(
+            time.strptime(suite["captured"], "%Y-%m-%dT%H:%M:%S"))
+        if age_s > max_age_h * 3600:
+            return None
+    except Exception:
+        return None
+    return suite
+
+
+def _worker_alive() -> bool:
+    """Is the background chip worker (tools/chip_worker.py) holding the
+    chip right now? If so, probing the relay from here would fail (and
+    SIGTERM-ing a hung probe risks wedging it) — prefer waiting for the
+    worker's incremental cache instead."""
+    path = os.path.join(_HERE, "tools", "chipq", "status.json")
+    try:
+        with open(path) as f:
+            st = json.load(f)
+        if st.get("phase") == "exited":
+            return False
+        if time.time() - os.path.getmtime(path) > 4 * 3600:
+            return False  # stale status (committed snapshot + pid reuse)
+        os.kill(int(st["pid"]), 0)
+        return True
+    except Exception:
+        return False
+
+
+def main():
+    """Fast, wedge-proof reporter. Preference order:
+
+    1. A TPU-backed ``BENCH_TPU_CACHE.json`` written by the background chip
+       worker this round — emit in milliseconds, no backend init at all.
+    2. Worker alive but cache not ready: poll for the cache (<=10 min).
+    3. No worker: bounded relay patience (6 min), then a LIVE suite run.
+    4. CPU smoke fallback — loud, rc=3, but always a parseable line.
+
+    rc=124 (driver window timeout, the round-3 artifact killer) is designed
+    out: every path above is bounded well under the driver's window."""
+    suite = _load_cache()
+    if suite is not None:
+        with open(os.path.join(_HERE, "BENCH_SUITE.json"), "w") as f:
+            json.dump(suite, f, indent=1)
+        _emit(suite, cached=True)
+
+    # on the CPU-smoke re-exec, skip the worker poll (it already failed
+    # once — re-entering it would loop forever)
+    worker_was_alive = (os.environ.get("APEX_TPU_BENCH_CPU") != "1"
+                        and _worker_alive())
+    if worker_was_alive:
+        deadline = time.monotonic() + 600
+        while time.monotonic() < deadline:
+            suite = _load_cache()
+            if suite is not None:
+                break
+            time.sleep(20)
+        suite = _load_cache() or _load_cache(require_complete=False)
+        if suite is not None:  # accept even a partial capture at deadline
+            with open(os.path.join(_HERE, "BENCH_SUITE.json"), "w") as f:
+                json.dump(suite, f, indent=1)
+            _emit(suite, cached=True)
+
+    if worker_was_alive and _worker_alive():
+        # the worker still holds the chip and never produced a usable
+        # capture: probing the relay against it would fail (or worse, a
+        # SIGTERM-ed hung probe could wedge it) and run_suite would race
+        # the worker's writer — go straight to the loud CPU smoke.
+        from __graft_entry__ import sanitized_cpu_env
+        env = sanitized_cpu_env()
+        env["APEX_TPU_BENCH_CPU"] = "1"
+        os.execve(sys.executable, [sys.executable, __file__], env)
+
+    jax, backend = _backend_with_timeout(probe_s=120, total_s=360)
+    import jax.numpy as jnp
+
+    out = os.path.join(
+        _HERE, "BENCH_TPU_CACHE.json" if backend == "tpu"
+        else "BENCH_SMOKE.json")
+    suite = run_suite(jax, jnp, backend, out_path=out)
+    if backend == "tpu":
+        with open(os.path.join(_HERE, "BENCH_SUITE.json"), "w") as f:
+            json.dump(suite, f, indent=1)
+    _emit(suite, cached=False)
 
 
 if __name__ == "__main__":

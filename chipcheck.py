@@ -433,11 +433,13 @@ CHECKS = [
 ]
 
 
-def main():
+def run_checks(jax, jnp, backend: str, out_path: str | None = None) -> dict:
+    """Run every check against an ALREADY-initialized backend, writing the
+    results dict to ``out_path`` incrementally (rewritten after each check,
+    so a mid-run crash still leaves a partial artifact). Separated from
+    main() so the background chip worker (tools/chip_worker.py) can invoke
+    the checks in-process without re-probing the relay."""
     global SMALL
-    jax, backend = _acquire_backend()
-    import jax.numpy as jnp
-
     SMALL = backend != "tpu"  # interpret-mode smoke: keep shapes tiny
 
     results = {"backend": backend,
@@ -457,14 +459,24 @@ def main():
         print(f"[chipcheck] {name}: "
               f"{'PASS' if r.get('pass') else 'FAIL'} {r}",
               file=sys.stderr, flush=True)
-    results["ok"] = bool(all_ok and backend == "tpu")
+        results["ok"] = bool(all_ok and backend == "tpu")
+        if out_path is not None:  # atomic for concurrent readers
+            with open(out_path + ".tmp", "w") as f:
+                json.dump(results, f, indent=1)
+            os.replace(out_path + ".tmp", out_path)
+    return results
+
+
+def main():
+    jax, backend = _acquire_backend()
+    import jax.numpy as jnp
 
     here = os.path.dirname(os.path.abspath(__file__))
     # smoke runs must not clobber the on-chip acceptance artifact
     name = ("CHIPCHECK_SMOKE.json" if backend != "tpu"
             else "CHIPCHECK.json")
-    with open(os.path.join(here, name), "w") as f:
-        json.dump(results, f, indent=1)
+    results = run_checks(jax, jnp, backend,
+                         out_path=os.path.join(here, name))
     print(json.dumps({"ok": results["ok"], "backend": backend,
                       "passed": sum(1 for n, _ in CHECKS
                                     if results[n].get("pass")),

@@ -1,0 +1,135 @@
+"""Unbounded background chip worker (VERDICT r3 item 1).
+
+ONE long-lived process that initializes the JAX backend ONCE (blocking as
+long as the relay needs — never under `timeout`, never killed: SIGTERM-ing
+a TPU-holding process wedges the axon relay for hours) and then executes
+queued job scripts in-process, sequentially, each writing its own artifact
+incrementally. Split of acquisition from reporting: bench.py only READS the
+artifacts this worker writes, so the driver's bounded bench window can
+never rc=124 again.
+
+Usage (from the repo root):
+
+    nohup python -u tools/chip_worker.py >> tools/chipq/worker.log 2>&1 &
+
+Queue protocol:
+- jobs are ``tools/chipq/q*.py``, executed in sorted order via runpy;
+- a finished job leaves ``tools/chipq/done/<name>.json`` ({ok, wall_s, ...});
+  delete the marker to re-run a job after editing it;
+- ``apex_tpu``/``bench``/``chipcheck`` modules are purged from sys.modules
+  before every job so edits made after worker launch take effect;
+- ``tools/chipq/STOP`` (or CHIPQ_IDLE_EXIT_S seconds with an empty queue)
+  makes the worker exit cleanly, RELEASING the chip claim so the driver's
+  end-of-round bench/dryrun can reach the relay;
+- ``tools/chipq/status.json`` carries {pid, phase, backend, job} for
+  outside observers (bench.py checks it before daring to probe).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import runpy
+import sys
+import time
+import traceback
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+QDIR = os.path.join(ROOT, "tools", "chipq")
+DONE = os.path.join(QDIR, "done")
+STATUS = os.path.join(QDIR, "status.json")
+
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def log(msg: str) -> None:
+    print(f"[worker {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def write_status(**kw) -> None:
+    kw.setdefault("pid", os.getpid())
+    kw["t"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    tmp = STATUS + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(kw, f, indent=1)
+    os.replace(tmp, STATUS)
+
+
+def purge_repo_modules() -> None:
+    """Drop repo-owned modules so each job re-imports current source."""
+    for name in list(sys.modules):
+        head = name.split(".")[0]
+        if head in ("apex_tpu", "bench", "chipcheck", "tune_flash",
+                    "bench_cli", "__graft_entry__"):
+            del sys.modules[name]
+
+
+def main() -> None:
+    os.makedirs(DONE, exist_ok=True)
+    write_status(phase="importing_jax")
+    t0 = time.time()
+    log("initializing JAX backend (may block on the relay; that is fine)")
+    import jax  # noqa: F401  — the long pole; never under a timeout
+
+    try:  # persistent compile cache shortens re-measurement jobs
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(ROOT, ".jax_cache"))
+    except Exception:
+        pass
+    backend = jax.default_backend()
+    acquire_s = round(time.time() - t0, 1)
+    write_status(phase="ready", backend=backend, acquire_s=acquire_s)
+    log(f"backend={backend} acquired in {acquire_s}s; "
+        f"devices={jax.devices()}")
+
+    idle_exit_s = float(os.environ.get("CHIPQ_IDLE_EXIT_S", "1800"))
+    last_work = time.time()
+    while True:
+        if os.path.exists(os.path.join(QDIR, "STOP")):
+            log("STOP file present — exiting cleanly")
+            break
+        jobs = sorted(f for f in os.listdir(QDIR)
+                      if f.startswith("q") and f.endswith(".py"))
+        pending = [j for j in jobs
+                   if not os.path.exists(os.path.join(DONE, j + ".json"))]
+        if not pending:
+            if time.time() - last_work > idle_exit_s:
+                log(f"queue idle for {idle_exit_s:.0f}s — exiting to "
+                    "release the chip claim")
+                break
+            write_status(phase="idle", backend=backend,
+                         done=len(jobs), pending=0)
+            time.sleep(15)
+            continue
+        name = pending[0]
+        write_status(phase="running", backend=backend, job=name)
+        log(f"running {name}")
+        rec = {"job": name, "backend": backend,
+               "started": time.strftime("%Y-%m-%dT%H:%M:%S")}
+        t0 = time.time()
+        try:
+            purge_repo_modules()
+            runpy.run_path(os.path.join(QDIR, name), run_name="chipq_job")
+            rec["ok"] = True
+        except SystemExit as e:
+            rec["ok"] = e.code in (0, None)
+            rec["exit"] = e.code
+        except MemoryError:
+            rec["ok"] = False
+            rec["error"] = "MemoryError"
+        except Exception:
+            rec["ok"] = False
+            rec["error"] = traceback.format_exc()[-4000:]
+        rec["wall_s"] = round(time.time() - t0, 1)
+        with open(os.path.join(DONE, name + ".json"), "w") as f:
+            json.dump(rec, f, indent=1)
+        log(f"done {name} ok={rec['ok']} wall={rec['wall_s']}s"
+            + (f" error={rec.get('error', '')[-300:]}" if not rec["ok"]
+               else ""))
+        last_work = time.time()
+    write_status(phase="exited", backend=backend)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""Chip job: compiled-kernel parity artifact (CHIPCHECK.json).
+
+Runs chipcheck.run_checks against the worker's already-initialized backend.
+Writes incrementally; raises if any kernel fails so the done-marker records
+the failure.
+"""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402  (already initialized by the worker)
+import jax.numpy as jnp  # noqa: E402
+
+import chipcheck  # noqa: E402
+
+backend = jax.default_backend()
+out = os.path.join(ROOT, "CHIPCHECK.json" if backend == "tpu"
+                   else "CHIPCHECK_SMOKE.json")
+results = chipcheck.run_checks(jax, jnp, backend, out_path=out)
+if not results.get("ok"):
+    failed = [n for n, _ in chipcheck.CHECKS
+              if not results.get(n, {}).get("pass")]
+    raise AssertionError(f"chipcheck not ok (backend={backend}, "
+                         f"failed={failed})")

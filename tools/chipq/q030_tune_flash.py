@@ -1,0 +1,22 @@
+"""Chip job: flash-attention block sweep -> tools/tune_flash.out."""
+
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+import tune_flash  # noqa: E402
+
+with open(os.path.join(ROOT, "tools", "tune_flash.out"), "a") as f:
+    best = tune_flash.run_sweep(jax, jnp, out=f)
+if jax.default_backend() != "tpu":
+    raise AssertionError("sweep ran on CPU")
+if best is None:
+    raise AssertionError("sweep produced no successful config")

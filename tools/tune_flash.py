@@ -20,20 +20,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    from bench import wait_for_backend
-
-    if not wait_for_backend(tag="tune_flash"):
-        print(json.dumps({"error": "backend unreachable"}))
-        sys.exit(2)
-    import jax
-    import jax.numpy as jnp
-
+def run_sweep(jax, jnp, out=sys.stdout):
+    """Run the block sweep against an already-initialized backend, printing
+    one JSON line per config to ``out`` as it completes. Callable from the
+    background chip worker without re-probing the relay."""
     from apex_tpu.ops.pallas.flash_attention import flash_attention
     from apex_tpu.utils.benchtime import measure_fetch_floor, timed_steps
 
+    def emit(obj):
+        print(json.dumps(obj), file=out, flush=True)
+
     backend = jax.default_backend()
-    print(f"# backend={backend}", flush=True)
+    print(f"# backend={backend}", file=out, flush=True)
     on_tpu = backend == "tpu"
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     peak = {"v5e": 197.0, "v6e": 918.0, "v5p": 459.0}.get(gen, 197.0)
@@ -86,21 +84,33 @@ def main():
             t0 = time.perf_counter()
             r = measure(b, h, s, d, bq, bk, iters)
             r["wall_s"] = round(time.perf_counter() - t0, 1)
-            print(json.dumps(r), flush=True)
+            emit(r)
             if best is None or r["fwd_tflops"] > best["fwd_tflops"]:
                 best = r
         except Exception as e:
-            print(json.dumps({"bq": bq, "bk": bk,
-                              "error": f"{type(e).__name__}: {e}"}),
-                  flush=True)
+            emit({"bq": bq, "bk": bk,
+                  "error": f"{type(e).__name__}: {e}"})
     if on_tpu and best is not None:
         # d=128 reference point at the winning blocks
         try:
             r = measure(4, 8, 2048, 128, best["bq"], best["bk"], iters)
-            print(json.dumps(r), flush=True)
+            emit(r)
         except Exception as e:
-            print(json.dumps({"shape": "d128", "error": str(e)}), flush=True)
-    print(json.dumps({"best": best}), flush=True)
+            emit({"shape": "d128", "error": str(e)})
+    emit({"best": best})
+    return best
+
+
+def main():
+    from bench import wait_for_backend
+
+    if not wait_for_backend(tag="tune_flash"):
+        print(json.dumps({"error": "backend unreachable"}))
+        sys.exit(2)
+    import jax
+    import jax.numpy as jnp
+
+    run_sweep(jax, jnp)
 
 
 if __name__ == "__main__":
