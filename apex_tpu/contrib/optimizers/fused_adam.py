@@ -69,6 +69,107 @@ def checkpoint_counter(val, fallback, cls_name: str):
     return out
 
 
+def _adam_denom(v_new, eps, eps_mode):
+    return (jnp.sqrt(v_new + eps) if eps_mode == 0
+            else jnp.sqrt(v_new) + eps)
+
+
+def _bc_step_size(lr, betas, step, bias_correction):
+    """Bias-correction folded into step_size, as the legacy kernel's host
+    side does (fused_adam_cuda_kernel.cu:182-189). Shared by step and
+    undo_step so the two can never desynchronize."""
+    if not bias_correction:
+        return lr
+    b1, b2 = betas
+    bc1 = 1.0 - b1 ** step
+    bc2 = 1.0 - b2 ** step
+    return lr * (bc2 ** 0.5) / bc1
+
+
+def reversible_adam(params: Any, grads: Any, exp_avg: Any, exp_avg_sq: Any,
+                    *, step_size, betas=(0.9, 0.999), eps: float = 1e-8,
+                    eps_inside_sqrt: bool = False, weight_decay: float = 0.0,
+                    grad_scale: float = 1.0, output_dtype=None):
+    """``reversible_adam`` (fused_adam_cuda_kernel.cu:421-494): an Adam step
+    whose per-ELEMENT finite check leaves non-finite lanes untouched (the
+    regular kernel skips the whole step), so the step can later be exactly
+    reverted by :func:`maybe_adam_undo` given the same grads. Moments and
+    the update run in fp32; ``step_size`` is the bias-corrected lr (the
+    legacy kernel folds correction into step_size). Returns
+    ``(params, exp_avg, exp_avg_sq, overflow[, params_copy])`` —
+    ``params_copy`` (the low-precision copy-out, ``p_copy`` in the kernel)
+    only when ``output_dtype`` is given; ``overflow`` is a scalar bool
+    (the kernel signals it by writing inf into ``p_copy[0]``)."""
+    b1, b2 = betas
+    eps_mode = 0 if eps_inside_sqrt else 1
+    leaves = list(zip(jax.tree_util.tree_leaves(params),
+                      jax.tree_util.tree_leaves(grads),
+                      jax.tree_util.tree_leaves(exp_avg),
+                      jax.tree_util.tree_leaves(exp_avg_sq)))
+    outs, overflow = [], jnp.asarray(False)
+    for p, g, m, v in leaves:
+        p32 = p.astype(jnp.float32)
+        s = g.astype(jnp.float32) / grad_scale
+        fin = jnp.isfinite(s)
+        s0 = jnp.where(fin, s, 0.0)
+        m_new = b1 * m + (1.0 - b1) * s0
+        v_new = b2 * v + (1.0 - b2) * s0 * s0
+        upd = m_new / _adam_denom(v_new, eps, eps_mode) + weight_decay * p32
+        p_new = p32 - step_size * upd
+        outs.append((jnp.where(fin, p_new, p32).astype(p.dtype),
+                     jnp.where(fin, m_new, m), jnp.where(fin, v_new, v)))
+        overflow = overflow | jnp.any(~fin)
+    treedef = jax.tree_util.tree_structure(params)
+    unflat = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
+        treedef, [o[i] for o in outs])
+    p_out, m_out, v_out = unflat(0), unflat(1), unflat(2)
+    if output_dtype is not None:
+        copy = jax.tree_util.tree_map(
+            lambda p: p.astype(output_dtype), p_out)
+        return p_out, m_out, v_out, overflow, copy
+    return p_out, m_out, v_out, overflow
+
+
+def maybe_adam_undo(params: Any, grads: Any, exp_avg: Any, exp_avg_sq: Any,
+                    *, step_size, betas=(0.9, 0.999), eps: float = 1e-8,
+                    eps_inside_sqrt: bool = False, weight_decay: float = 0.0,
+                    grad_scale: float = 1.0, overflow_flag=True):
+    """``maybe_adam_undo`` (fused_adam_cuda_kernel.cu:497-560): exact fp32
+    inverse of :func:`reversible_adam` given the SAME grads — the
+    step-undo the reference's DistributedFusedLAMB grad-accumulation flow
+    uses to revert an optimistically-applied step once a late global
+    overflow is detected. ``overflow_flag`` gates the whole undo (the
+    kernel early-outs when the flag is 0); non-finite grad lanes were never
+    applied, so they are left untouched here too. v is clamped at 0 against
+    round-off when reverting the very first step (kernel :549-551)."""
+    b1, b2 = betas
+    eps_mode = 0 if eps_inside_sqrt else 1
+    flag = jnp.asarray(overflow_flag)
+    leaves = list(zip(jax.tree_util.tree_leaves(params),
+                      jax.tree_util.tree_leaves(grads),
+                      jax.tree_util.tree_leaves(exp_avg),
+                      jax.tree_util.tree_leaves(exp_avg_sq)))
+    outs = []
+    for p, g, m, v in leaves:
+        p32 = p.astype(jnp.float32)
+        s = g.astype(jnp.float32) / grad_scale
+        fin = jnp.isfinite(s)
+        s0 = jnp.where(fin, s, 0.0)
+        # denom uses the POST-step v (what the forward divided by)
+        denom = _adam_denom(v, eps, eps_mode)
+        p_prev = (p32 + step_size * (m / denom)) / \
+            (1.0 - step_size * weight_decay)
+        m_prev = (m - (1.0 - b1) * s0) / b1
+        v_prev = jnp.maximum((v - (1.0 - b2) * s0 * s0) / b2, 0.0)
+        do = flag & fin
+        outs.append((jnp.where(do, p_prev, p32).astype(p.dtype),
+                     jnp.where(do, m_prev, m), jnp.where(do, v_prev, v)))
+    treedef = jax.tree_util.tree_structure(params)
+    unflat = lambda i: jax.tree_util.tree_unflatten(  # noqa: E731
+        treedef, [o[i] for o in outs])
+    return unflat(0), unflat(1), unflat(2)
+
+
 class FusedAdam:
     def __init__(self, params: Any, lr: float = 1e-3,
                  bias_correction: bool = True, betas=(0.9, 0.999),
@@ -142,13 +243,7 @@ class FusedAdam:
         lr = self.lr if lr is None else lr
         b1, b2 = self.betas
 
-        combined = float(scale) * self._amp_scale_adjustment
-        if self.max_grad_norm > 0 and grad_norms is not None:
-            gnorm = jnp.asarray(grad_norms, jnp.float32)
-            if gnorm.ndim > 0:
-                gnorm = jnp.sqrt(jnp.sum(gnorm ** 2))
-            clip = gnorm / (combined * self.max_grad_norm)
-            combined = combined * jnp.maximum(clip, 1.0)
+        combined = self._combined_scale(scale, grad_norms)
 
         # legacy kernel folds bias correction into step_size and keeps v raw
         # (fused_adam_cuda_kernel.cu:182-189). max(step, 1): when the very
@@ -158,12 +253,8 @@ class FusedAdam:
             step_for_bc = jnp.maximum(self._step, 1)
         else:
             step_for_bc = max(self._step, 1)
-        if self.bias_correction:
-            bc1 = 1.0 - b1 ** step_for_bc
-            bc2 = 1.0 - b2 ** step_for_bc
-            step_size = lr * (bc2 ** 0.5) / bc1
-        else:
-            step_size = lr
+        step_size = _bc_step_size(lr, self.betas, step_for_bc,
+                                  self.bias_correction)
 
         eps, wd, eps_mode = self.eps, self.weight_decay, self.eps_mode
 
@@ -174,10 +265,7 @@ class FusedAdam:
             g32 = g.astype(jnp.float32) / combined
             m_new = b1 * m + (1.0 - b1) * g32
             v_new = b2 * v + (1.0 - b2) * g32 * g32
-            if eps_mode == 0:
-                denom = jnp.sqrt(v_new + eps)
-            else:
-                denom = jnp.sqrt(v_new) + eps
+            denom = _adam_denom(v_new, eps, eps_mode)
             # decay joins the UPDATE term, after the moments
             # (fused_adam_cuda_kernel.cu:58)
             update = m_new / denom + wd * p32
@@ -214,6 +302,48 @@ class FusedAdam:
             return self.parameters, out
         if loss is not None:
             return loss, self.parameters
+        return self.parameters
+
+    def _combined_scale(self, scale, grad_norms):
+        """scale·amp-adjustment·clip — the divisor the kernel applies to
+        grads (``combined_scale`` in fused_adam.py:119-126 of the
+        reference). Shared by step and undo_step."""
+        combined = float(scale) * self._amp_scale_adjustment
+        if self.max_grad_norm > 0 and grad_norms is not None:
+            gnorm = jnp.asarray(grad_norms, jnp.float32)
+            if gnorm.ndim > 0:
+                gnorm = jnp.sqrt(jnp.sum(gnorm ** 2))
+            clip = gnorm / (combined * self.max_grad_norm)
+            combined = combined * jnp.maximum(clip, 1.0)
+        return combined
+
+    def undo_step(self, grads: Any, scale: float = 1.0,
+                  grad_norms=None, lr: Optional[float] = None,
+                  overflow=True):
+        """Revert the most recent applied ``step`` given the SAME grads —
+        the class-level surface over :func:`maybe_adam_undo` (the reference
+        flow: DistributedFusedLAMB applies optimistically during grad
+        accumulation, then undoes when a late global overflow lands).
+        Pass the same ``scale``/``grad_norms``/``lr`` the forward step got
+        (``grad_norms`` matters when ``max_grad_norm`` clipping was active —
+        the combined divisor must match for the inverse to be exact).
+        Decrements the step counter so bias correction realigns. Exact in
+        fp32 (params/moments fp32); low-precision params round-trip to
+        their dtype's resolution."""
+        if isinstance(self._step, jax.core.Tracer) or self._step < 1:
+            raise RuntimeError("undo_step needs a concrete applied step")
+        lr = self.lr if lr is None else lr
+        step_size = _bc_step_size(lr, self.betas, self._step,
+                                  self.bias_correction)
+        self.parameters, self.exp_avg, self.exp_avg_sq = maybe_adam_undo(
+            self.parameters, grads, self.exp_avg, self.exp_avg_sq,
+            step_size=step_size, betas=self.betas, eps=self.eps,
+            eps_inside_sqrt=(self.eps_mode == 0),
+            weight_decay=self.weight_decay,
+            grad_scale=self._combined_scale(scale, grad_norms),
+            overflow_flag=overflow)
+        self._step -= 1
+        self._step_host = int(self._step)
         return self.parameters
 
     def state_dict(self):

@@ -72,9 +72,10 @@ def _sm_fwd_kernel(*refs, scale, causal, has_mask, sk_orig, br, skp):
     m = jnp.max(x32, axis=-1, keepdims=True)
     e = jnp.exp(x32 - m)
     s = jnp.sum(e, axis=-1, keepdims=True)
-    y = e / s
-    # fully-masked row (max == fill) → zeros, scaled_masked_softmax.h:297
-    y = jnp.where(m <= MASK_FILL, 0.0, y)
+    # reciprocal-multiply (one divide per ROW, then a row-broadcast mul)
+    # instead of a per-element divide; fully-masked row (max == fill) →
+    # zeros, scaled_masked_softmax.h:297
+    y = e * jnp.where(m <= MASK_FILL, 0.0, 1.0 / s)
     o_ref[0] = y.astype(o_ref.dtype)
 
 

@@ -16,8 +16,31 @@ specifics on top:
   tensors, exactly the reference's two-phase structure
   (multi_tensor_lamb_compute_update_term → update_weights,
   apex/contrib/csrc/optimizers/multi_tensor_distopt_lamb.cpp:18-21).
-- ``set_is_accumulation_step`` maps to simply not calling step() during
-  accumulation (grad accumulation is a jnp add in the user loop).
+
+Grad-sync modes (reference :845 ``_full_all_reduce`` vs :903
+``_reduce_scatter_and_all_reduce``): under GSPMD the collective pattern is
+chosen by the sharding constraint placed on the flat grad buffer before the
+moment update —
+- ``full_ar=True``: the grad buffer is constrained REPLICATED (XLA emits an
+  all-reduce-shaped sync; every device holds the full gradient) and each
+  device then slices its own state shard locally — the reference's
+  single-node DGX mode, which trades bandwidth for one fewer collective
+  hop on the update path.
+- ``full_ar=False`` (default): the grad buffer is constrained to the
+  1-D shard (XLA emits reduce-scatter-shaped resharding; each device
+  materializes only grad-shard bytes) — the reference's multi-node mode.
+Both are numerically identical (tests assert this), they differ only in
+which collectives the compiled module contains.
+
+- ``fused_norm``/``fuse_scale`` (:119, :171): the L2 norm and the
+  ``inv_scale`` multiply are ALREADY fused into the single jitted step here
+  (XLA fuses the norm's partial-sum into the scale pass); the kwargs are
+  accepted for API parity and validated, not dispatched.
+- ``set_is_accumulation_step(True)`` (:787) makes step() ACCUMULATE: grads
+  are added into a sharded flat accumulation buffer (shard-local adds; under
+  GSPMD grad-sum placement belongs to the caller's backward) and the next
+  real step folds the buffer in and zeros it — the reference's
+  skip-sync-while-accumulating flow, with the flag actually gating state.
 """
 
 from __future__ import annotations
@@ -41,7 +64,9 @@ class DistributedFusedLAMB:
                  max_grad_norm: float = 1.0, adam_w_mode: bool = True,
                  grad_averaging: bool = True, use_nvlamb: bool = False,
                  axis: str = "data", state_dtype=jnp.float32,
-                 clip_after_ar: bool = True, **_compat):
+                 clip_after_ar: bool = True, full_ar: bool = False,
+                 fused_norm: bool = True, fuse_scale: bool = True,
+                 **_compat):
         self.mesh = mesh
         self.axis = axis
         self.lr = lr
@@ -54,6 +79,10 @@ class DistributedFusedLAMB:
         self.grad_averaging = grad_averaging
         self.use_nvlamb = use_nvlamb
         self.clip_after_ar = clip_after_ar
+        self.full_ar = full_ar
+        # reference :176 — fused_norm only applies when clipping pre-AR
+        self.fused_norm = fused_norm if not clip_after_ar else False
+        self.fuse_scale = fuse_scale
 
         world = mesh.shape[axis]
         self._spec = flat_spec(params)
@@ -68,12 +97,37 @@ class DistributedFusedLAMB:
         self._params = params
         self._step = jnp.zeros((), jnp.int32)
         self._is_accumulation_step = False
+        self._acc = None  # sharded flat grad-accumulation buffer
         self._jit = None
+        self._jit_acc = None
 
     def set_is_accumulation_step(self, flag: bool):
-        """Parity with :787 — when True, step() is a no-op (caller keeps
-        accumulating grads)."""
+        """Parity with :787 — while True, step() accumulates grads into the
+        sharded flat buffer instead of updating; the next real step folds
+        the buffer in."""
         self._is_accumulation_step = flag
+
+    def _accumulate(self, grads, inv_scale, found_inf):
+        """Add ``grads * inv_scale`` into the sharded buffer; a found_inf
+        microbatch contributes NOTHING (the reference skips overflowed
+        microbatches rather than poisoning the accumulator)."""
+        if self._jit_acc is None:
+            spec, n, shard_s = self._spec, self._n, self._shard
+
+            def acc_fn(acc, grads, inv_scale, found_inf):
+                flat_g = flatten(grads, spec, dtype=_f32, pad_to=n)
+                flat_g = jax.lax.with_sharding_constraint(flat_g, shard_s)
+                # gate the PRODUCT: inv_scale·inf would make 0·inf = NaN
+                return acc + jnp.where(found_inf, 0.0, inv_scale * flat_g)
+
+            self._jit_acc = jax.jit(acc_fn, donate_argnums=(0,))
+        if self._acc is None:
+            self._acc = jax.device_put(jnp.zeros((self._n,), _f32),
+                                       self._shard)
+        with self.mesh:
+            self._acc = self._jit_acc(self._acc, grads,
+                                      jnp.asarray(inv_scale, _f32),
+                                      jnp.asarray(found_inf, jnp.bool_))
 
     def _build(self):
         spec = self._spec
@@ -87,10 +141,21 @@ class DistributedFusedLAMB:
         adam_w = self.adam_w_mode
         use_nvlamb = self.use_nvlamb
 
-        def step_fn(p32, m, v, grads, step, lr, inv_scale, found_inf):
+        # grad-sync mode (reference :845 vs :903): the constraint on the
+        # flat grad buffer picks the collective pattern XLA compiles —
+        # replicated ⇒ all-reduce-shaped (full_ar), sharded ⇒
+        # reduce-scatter-shaped (RS+AR). Numerics are identical.
+        grad_sharding = rep_s if self.full_ar else shard_s
+
+        def step_fn(p32, m, v, grads, acc, step, lr, inv_scale, found_inf):
             flat_g = flatten(grads, spec, dtype=_f32, pad_to=n)
-            flat_g = jax.lax.with_sharding_constraint(flat_g, shard_s)
+            flat_g = jax.lax.with_sharding_constraint(flat_g, grad_sharding)
             g32 = flat_g * inv_scale
+            if acc is not None:  # fold in accumulated grads (:787 flow) —
+                # the buffer is already unscaled (per-microbatch inv_scale
+                # applied at accumulation time)
+                g32 = g32 + jax.lax.with_sharding_constraint(
+                    acc, grad_sharding)
 
             # fused global grad norm + clip (padding is zero ⇒ exact)
             gnorm = jnp.sqrt(jnp.sum(g32 * g32))
@@ -144,11 +209,12 @@ class DistributedFusedLAMB:
                 jax.lax.with_sharding_constraint(flat_new, rep_s), spec)
             return p_out, m_out, v_out, params_out, gnorm
 
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2, 4))
 
     def step(self, grads: Any, lr: Optional[float] = None, inv_scale=1.0,
              found_inf=False):
         if self._is_accumulation_step:
+            self._accumulate(grads, inv_scale, found_inf)
             return self._params
         if self._jit is None:
             self._jit = self._build()
@@ -156,10 +222,12 @@ class DistributedFusedLAMB:
             jnp.asarray(found_inf, jnp.bool_), 0, 1).astype(jnp.int32)
         with self.mesh:
             self._master, self._m, self._v, params, gnorm = self._jit(
-                self._master, self._m, self._v, grads, self._step,
+                self._master, self._m, self._v, grads, self._acc,
+                self._step,
                 jnp.asarray(self.lr if lr is None else lr, _f32),
                 jnp.asarray(inv_scale, _f32),
                 jnp.asarray(found_inf, jnp.bool_))
+        self._acc = None  # buffer donated & consumed by the step
         self._params = params
         self.last_grad_norm = gnorm
         return params
@@ -177,7 +245,9 @@ class DistributedFusedLAMB:
     def state_dict(self):
         return {"step": int(self._step), "lr": self.lr,
                 "master": np.asarray(self._master),
-                "m": np.asarray(self._m), "v": np.asarray(self._v)}
+                "m": np.asarray(self._m), "v": np.asarray(self._v),
+                "acc": (None if self._acc is None
+                        else np.asarray(self._acc))}
 
     def load_state_dict(self, sd):
         self._step = jnp.asarray(sd["step"], jnp.int32)
@@ -185,5 +255,8 @@ class DistributedFusedLAMB:
         self._master = jax.device_put(jnp.asarray(sd["master"]), self._shard)
         self._m = jax.device_put(jnp.asarray(sd["m"]), self._shard)
         self._v = jax.device_put(jnp.asarray(sd["v"]), self._shard)
+        acc = sd.get("acc")
+        self._acc = (None if acc is None else
+                     jax.device_put(jnp.asarray(acc), self._shard))
         self._params = unflatten(self._master, self._spec)
         self._jit = None

@@ -249,9 +249,11 @@ def bench_softmax_rope(jax, jnp, on_tpu, chip, floor_s):
                           jnp.bfloat16) * 0.1
 
     def sm_step(i, x):
-        y = scaled_upper_triang_masked_softmax(x, 0.5)
-        # keep the carry distribution stable: renorm to ~unit entries
-        return (y * s).astype(x.dtype) * 0.1
+        # softmax output is a stable input distribution (rows sum to 1,
+        # entries ~1/sk), so the carry chains straight through with NO
+        # extra elementwise pass — the old `(y*s)*0.1` renorm was its own
+        # read+write over the matrix and halved the apparent hbm_frac
+        return scaled_upper_triang_masked_softmax(x, 0.5).astype(x.dtype)
 
     ms_sm = timed_steps(sm_step, x, iters=iters, floor_s=floor_s)
     sm_bytes = x.size * 2 * 2  # read + write bf16

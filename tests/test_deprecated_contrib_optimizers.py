@@ -268,3 +268,97 @@ class TestModernCallingConvention:
         # first applied step must use the momentum-init (buf = g) path
         np.testing.assert_allclose(np.asarray(sgd_skip),
                                    np.asarray(sgd_one_step()), atol=5e-6)
+
+
+class TestReversibleAdamUndo:
+    """reversible_adam + maybe_adam_undo roundtrip
+    (fused_adam_cuda_kernel.cu:421-560)."""
+
+    def _state(self, seed=0, n=513):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 4)
+        p = jax.random.normal(ks[0], (n,), jnp.float32)
+        g = jax.random.normal(ks[1], (n,), jnp.float32)
+        m = jax.random.normal(ks[2], (n,)) * 0.1
+        v = jnp.abs(jax.random.normal(ks[3], (n,))) * 0.01
+        return p, g, m, v
+
+    def test_roundtrip_exact_fp32(self):
+        from apex_tpu.contrib.optimizers.fused_adam import (maybe_adam_undo,
+                                                            reversible_adam)
+        p, g, m, v = self._state()
+        kw = dict(step_size=0.01, betas=(0.9, 0.999), eps=1e-8,
+                  weight_decay=0.01, grad_scale=2.0)
+        p1, m1, v1, ovf = reversible_adam([p], [g], [m], [v], **kw)
+        assert not bool(ovf)
+        p0, m0, v0 = maybe_adam_undo(p1, [g], m1, v1, **kw)
+        np.testing.assert_allclose(_np(p0[0]), _np(p), rtol=2e-6, atol=2e-6)
+        np.testing.assert_allclose(_np(m0[0]), _np(m), rtol=2e-6, atol=2e-7)
+        np.testing.assert_allclose(_np(v0[0]), _np(v), rtol=2e-5, atol=1e-8)
+
+    def test_per_element_finite_skip_and_overflow(self):
+        from apex_tpu.contrib.optimizers.fused_adam import reversible_adam
+        p, g, m, v = self._state()
+        g = g.at[7].set(jnp.inf).at[100].set(jnp.nan)
+        p1, m1, v1, ovf = reversible_adam([p], [g], [m], [v], step_size=0.01)
+        assert bool(ovf)
+        # non-finite lanes untouched, others updated
+        np.testing.assert_array_equal(_np(p1[0][7]), _np(p[7]))
+        np.testing.assert_array_equal(_np(m1[0][100]), _np(m[100]))
+        assert not np.allclose(_np(p1[0][0]), _np(p[0]))
+
+    def test_output_dtype_copy_out(self):
+        from apex_tpu.contrib.optimizers.fused_adam import reversible_adam
+        p, g, m, v = self._state()
+        p1, m1, v1, ovf, copy = reversible_adam(
+            [p], [g], [m], [v], step_size=0.01, output_dtype=jnp.bfloat16)
+        assert copy[0].dtype == jnp.bfloat16
+        np.testing.assert_allclose(_np(copy[0]), _np(p1[0]), rtol=1e-2)
+
+    def test_undo_gated_by_flag(self):
+        from apex_tpu.contrib.optimizers.fused_adam import maybe_adam_undo
+        p, g, m, v = self._state()
+        p0, m0, v0 = maybe_adam_undo([p], [g], [m], [v], step_size=0.01,
+                                     overflow_flag=False)
+        np.testing.assert_array_equal(_np(p0[0]), _np(p))
+        np.testing.assert_array_equal(_np(v0[0]), _np(v))
+
+    def test_class_undo_step_roundtrip(self):
+        p, g = self._state()[:2]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedAdam([p], lr=0.01, weight_decay=0.01)
+        for s in range(3):
+            opt.step(grads=[g * (1 + s)], scale=2.0)
+        snap = _np(opt.parameters[0])
+        opt.step(grads=[g * 4], scale=2.0)
+        opt.undo_step([g * 4], scale=2.0)
+        assert opt._step == 3
+        np.testing.assert_allclose(_np(opt.parameters[0]), snap,
+                                   rtol=2e-6, atol=2e-6)
+        # counter realigned: stepping again reproduces the un-done step
+        redo = opt.step(grads=[g * 4], scale=2.0)
+        assert opt._step == 4
+
+    def test_undo_first_step_v_clamped(self):
+        from apex_tpu.contrib.optimizers.fused_adam import (maybe_adam_undo,
+                                                            reversible_adam)
+        p, g, _, _ = self._state()
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        p1, m1, v1, _ = reversible_adam([p], [g], [m], [v], step_size=0.01)
+        p0, m0, v0 = maybe_adam_undo(p1, [g], m1, v1, step_size=0.01)
+        assert bool(jnp.all(v0[0] >= 0.0))
+        np.testing.assert_allclose(_np(p0[0]), _np(p), rtol=2e-5, atol=2e-5)
+
+    def test_undo_with_grad_norm_clipping(self):
+        p, g = self._state()[:2]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            opt = FusedAdam([p], lr=0.01, max_grad_norm=0.5)
+        gnorm = jnp.sqrt(jnp.sum(g ** 2))  # >> max_grad_norm: clip active
+        opt.step(grads=[g], grad_norms=gnorm)
+        snap = _np(opt.parameters[0])
+        opt.step(grads=[g * 2], grad_norms=gnorm * 2)
+        opt.undo_step([g * 2], grad_norms=gnorm * 2)
+        np.testing.assert_allclose(_np(opt.parameters[0]), snap,
+                                   rtol=2e-6, atol=2e-6)
