@@ -465,6 +465,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _CACHE = os.path.join(_HERE, "BENCH_TPU_CACHE.json")
 
 
+def atomic_write_json(path: str, obj) -> None:
+    """Write-tmp-then-rename so concurrent readers (bench.py polls the
+    worker's incremental artifacts) never observe a truncated file. Shared
+    by bench, chipcheck, the chip worker and its queue jobs."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
 def run_suite(jax, jnp, backend: str, out_path: str | None = None) -> dict:
     """Run every bench against an ALREADY-initialized backend. The suite
     dict is rewritten to ``out_path`` after each bench so a mid-run crash
@@ -489,11 +499,8 @@ def run_suite(jax, jnp, backend: str, out_path: str | None = None) -> dict:
              "git": git, "complete": False}
 
     def flush():
-        if out_path is not None:  # atomic: a concurrent reader (bench.py
-            tmp = out_path + ".tmp"  # polling the cache) must never see a
-            with open(tmp, "w") as f:  # half-written file
-                json.dump(suite, f, indent=1)
-            os.replace(tmp, out_path)
+        if out_path is not None:
+            atomic_write_json(out_path, suite)
 
     flush()
     for name, fn in BENCHES:
@@ -535,12 +542,13 @@ def _emit(suite, cached: bool) -> None:
     sys.exit(0)
 
 
-def _load_cache(require_complete: bool = True, max_age_h: float = 24.0):
+def _load_cache(require_complete: bool = True, max_age_h: float = 14.0):
     """Return the TPU capture if it is usable, else None. ``max_age_h``
     rejects captures from a previous round (the driver restarts rounds on a
-    ~12 h cadence; a stale committed cache must not mask a regression —
-    the 'captured' stamp and 'git' rev are also carried into the emitted
-    headline so the record is auditable)."""
+    ~12 h cadence, so 14 h covers this round's earliest capture while
+    shutting out last round's committed one; the 'captured' stamp and
+    'git' rev are also carried into the emitted headline so the record is
+    auditable)."""
     try:
         with open(_CACHE) as f:
             suite = json.load(f)
@@ -576,8 +584,13 @@ def _worker_alive() -> bool:
             return False
         if time.time() - os.path.getmtime(path) > 4 * 3600:
             return False  # stale status (committed snapshot + pid reuse)
-        os.kill(int(st["pid"]), 0)
-        return True
+        pid = int(st["pid"])
+        os.kill(pid, 0)
+        # pid liveness is not identity: verify it IS the worker (a fresh
+        # checkout's status.json + pid collision must not stall bench.py)
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmd = f.read().decode("utf-8", "replace")
+        return "chip_worker" in cmd
     except Exception:
         return False
 

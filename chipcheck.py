@@ -442,10 +442,13 @@ def run_checks(jax, jnp, backend: str, out_path: str | None = None) -> dict:
     global SMALL
     SMALL = backend != "tpu"  # interpret-mode smoke: keep shapes tiny
 
+    from bench import atomic_write_json
+
     results = {"backend": backend,
                "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
                if backend == "tpu" else backend,
-               "compiled": backend == "tpu"}
+               "compiled": backend == "tpu",
+               "complete": False, "ok": False}
     all_ok = True
     for name, fn in CHECKS:
         t0 = time.perf_counter()
@@ -459,11 +462,14 @@ def run_checks(jax, jnp, backend: str, out_path: str | None = None) -> dict:
         print(f"[chipcheck] {name}: "
               f"{'PASS' if r.get('pass') else 'FAIL'} {r}",
               file=sys.stderr, flush=True)
-        results["ok"] = bool(all_ok and backend == "tpu")
-        if out_path is not None:  # atomic for concurrent readers
-            with open(out_path + ".tmp", "w") as f:
-                json.dump(results, f, indent=1)
-            os.replace(out_path + ".tmp", out_path)
+        if out_path is not None:
+            # "ok" stays False until EVERY check has run — a mid-run crash
+            # must not leave an artifact claiming overall success
+            atomic_write_json(out_path, results)
+    results["complete"] = True
+    results["ok"] = bool(all_ok and backend == "tpu")
+    if out_path is not None:
+        atomic_write_json(out_path, results)
     return results
 
 

@@ -37,10 +37,19 @@ import traceback
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 QDIR = os.path.join(ROOT, "tools", "chipq")
 DONE = os.path.join(QDIR, "done")
+FAILED = os.path.join(QDIR, "failed")
 STATUS = os.path.join(QDIR, "status.json")
 
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
+
+
+def _fail_count(job: str) -> int:
+    try:
+        return sum(1 for f in os.listdir(FAILED)
+                   if f.startswith(job + "."))
+    except FileNotFoundError:
+        return 0
 
 
 def log(msg: str) -> None:
@@ -48,12 +57,11 @@ def log(msg: str) -> None:
 
 
 def write_status(**kw) -> None:
+    from bench import atomic_write_json
+
     kw.setdefault("pid", os.getpid())
     kw["t"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-    tmp = STATUS + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(kw, f, indent=1)
-    os.replace(tmp, STATUS)
+    atomic_write_json(STATUS, kw)
 
 
 def purge_repo_modules() -> None:
@@ -67,18 +75,49 @@ def purge_repo_modules() -> None:
 
 def main() -> None:
     os.makedirs(DONE, exist_ok=True)
-    write_status(phase="importing_jax")
+    os.makedirs(FAILED, exist_ok=True)
+    attempt = int(os.environ.get("CHIPQ_ATTEMPT", "1"))
+    write_status(phase="importing_jax", attempt=attempt)
     t0 = time.time()
-    log("initializing JAX backend (may block on the relay; that is fine)")
-    import jax  # noqa: F401  — the long pole; never under a timeout
+    log(f"initializing JAX backend, attempt {attempt} (may block on the "
+        "relay; that is fine)")
+    try:
+        import jax  # noqa: F401  — the long pole; never under a timeout
 
-    try:  # persistent compile cache shortens re-measurement jobs
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(ROOT, ".jax_cache"))
-    except Exception:
-        pass
-    backend = jax.default_backend()
+        try:  # persistent compile cache shortens re-measurement jobs
+            jax.config.update("jax_compilation_cache_dir",
+                              os.path.join(ROOT, ".jax_cache"))
+        except Exception:
+            pass
+        backend = jax.default_backend()
+    except Exception as e:
+        # init RAISED (observed: UNAVAILABLE after ~2h on a wedged relay)
+        # rather than hanging. No claim is held after a failed init, and
+        # xla_bridge caches the failure — so retry with a FRESH interpreter
+        # via exec, forever. A clean raise is not the kill-mid-claim wedge
+        # case; re-exec is safe.
+        log(f"backend init failed ({type(e).__name__}: {e}); retrying in "
+            "120s via re-exec")
+        write_status(phase="init_retry_sleep", attempt=attempt,
+                     error=f"{type(e).__name__}: {e}"[:300])
+        time.sleep(120)
+        env = dict(os.environ)
+        env["CHIPQ_ATTEMPT"] = str(attempt + 1)
+        os.execve(sys.executable, [sys.executable, "-u",
+                                   os.path.abspath(__file__)], env)
     acquire_s = round(time.time() - t0, 1)
+    if backend != "tpu" and os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+        # a CPU backend means the relay quietly handed us nothing — the
+        # queue jobs are chip-acceptance jobs; burning them in interpret
+        # mode helps no one. Retry for the TPU like an init failure.
+        log(f"backend came up as {backend!r}, not tpu; retrying in 120s")
+        write_status(phase="init_retry_sleep", attempt=attempt,
+                     error=f"backend={backend}")
+        time.sleep(120)
+        env = dict(os.environ)
+        env["CHIPQ_ATTEMPT"] = str(attempt + 1)
+        os.execve(sys.executable, [sys.executable, "-u",
+                                   os.path.abspath(__file__)], env)
     write_status(phase="ready", backend=backend, acquire_s=acquire_s)
     log(f"backend={backend} acquired in {acquire_s}s; "
         f"devices={jax.devices()}")
@@ -91,8 +130,16 @@ def main() -> None:
             break
         jobs = sorted(f for f in os.listdir(QDIR)
                       if f.startswith("q") and f.endswith(".py"))
-        pending = [j for j in jobs
-                   if not os.path.exists(os.path.join(DONE, j + ".json"))]
+
+        def runnable(j):
+            # done marker ⇒ finished OK; failed markers are retried up to
+            # 3 times (a transient relay error must not permanently block a
+            # job, a deterministic failure must not loop forever)
+            if os.path.exists(os.path.join(DONE, j + ".json")):
+                return False
+            return _fail_count(j) < 3
+
+        pending = [j for j in jobs if runnable(j)]
         if not pending:
             if time.time() - last_work > idle_exit_s:
                 log(f"queue idle for {idle_exit_s:.0f}s — exiting to "
@@ -122,7 +169,12 @@ def main() -> None:
             rec["ok"] = False
             rec["error"] = traceback.format_exc()[-4000:]
         rec["wall_s"] = round(time.time() - t0, 1)
-        with open(os.path.join(DONE, name + ".json"), "w") as f:
+        if rec["ok"]:
+            marker = os.path.join(DONE, name + ".json")
+        else:
+            marker = os.path.join(FAILED,
+                                  f"{name}.{_fail_count(name) + 1}.json")
+        with open(marker, "w") as f:
             json.dump(rec, f, indent=1)
         log(f"done {name} ok={rec['ok']} wall={rec['wall_s']}s"
             + (f" error={rec.get('error', '')[-300:]}" if not rec["ok"]

@@ -19,6 +19,9 @@ import jax.numpy as jnp  # noqa: E402
 import chipcheck  # noqa: E402
 
 backend = jax.default_backend()
+if backend != "tpu" and os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError(f"backend={backend}: chipcheck must run compiled "
+                         "on the chip")
 out = os.path.join(ROOT, "CHIPCHECK.json" if backend == "tpu"
                    else "CHIPCHECK_SMOKE.json")
 results = chipcheck.run_checks(jax, jnp, backend, out_path=out)

@@ -18,6 +18,9 @@ import jax.numpy as jnp  # noqa: E402
 import bench  # noqa: E402
 
 backend = jax.default_backend()
+if backend != "tpu" and os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError(f"backend={backend}: refusing to burn the queue "
+                         "on an interpret-mode suite")
 out = os.path.join(ROOT, "BENCH_TPU_CACHE.json" if backend == "tpu"
                    else "BENCH_SMOKE.json")
 suite = bench.run_suite(jax, jnp, backend, out_path=out)

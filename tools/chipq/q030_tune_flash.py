@@ -11,6 +11,10 @@ if ROOT not in sys.path:
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
+if jax.default_backend() != "tpu" and \
+        os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError("backend is not tpu; sweep would be meaningless")
+
 sys.path.insert(0, os.path.join(ROOT, "tools"))
 import tune_flash  # noqa: E402
 

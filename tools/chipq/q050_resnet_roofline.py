@@ -1,0 +1,125 @@
+"""Chip job: ResNet-50 step roofline + trace (VERDICT r3 item 3).
+
+Builds the exact bench train step, compiles it, pulls XLA's own
+cost_analysis (flops + bytes accessed) and compares the measured step time
+against the chip roofline max(flops/peak, bytes/bw) — proving whether the
+0.80x-A100 residual is chip-bound or implementation slack. Also attempts a
+jax.profiler device trace (best-effort on the tunneled runtime). Writes
+RESNET50_ROOFLINE.json.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bench  # noqa: E402
+from apex_tpu.models.resnet import ResNet18ish, ResNet50  # noqa: E402
+from apex_tpu.optimizers.functional import adam_update  # noqa: E402
+from apex_tpu.utils.benchtime import (measure_fetch_floor,  # noqa: E402
+                                      timed_steps)
+
+backend = jax.default_backend()
+ON_TPU = backend == "tpu"
+gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+chip = bench._CHIP.get(gen, bench._CHIP["v5e"])
+
+if ON_TPU:
+    model, batch, hw, ncls = ResNet50(), 128, 224, 1000
+else:
+    model, batch, hw, ncls = ResNet18ish(), 8, 32, 10
+
+x = jax.random.normal(jax.random.PRNGKey(0), (batch, hw, hw, 3),
+                      jnp.bfloat16)
+y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, ncls, jnp.int32)
+variables = model.init(jax.random.PRNGKey(2), x)
+params, bstats = variables["params"], variables["batch_stats"]
+zeros = lambda p: jnp.zeros_like(p, jnp.float32)  # noqa: E731
+m0 = jax.tree_util.tree_map(zeros, params)
+v0 = jax.tree_util.tree_map(zeros, params)
+
+
+def train_step(i, state, x, y):
+    params, m, v, bstats = state
+
+    def loss_fn(p):
+        logits, updated = model.apply(
+            {"params": p, "batch_stats": bstats}, x, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(y, logits.shape[-1])
+        loss = -jnp.mean(jnp.sum(
+            jax.nn.log_softmax(logits) * onehot, axis=-1))
+        return loss, updated["batch_stats"]
+
+    (loss, bs2), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    params, m, v = adam_update(params, grads, m, v, step=i + 1,
+                               lr=1e-3, weight_decay=1e-4)
+    return (params, m, v, bs2)
+
+
+# --- XLA's own cost model for ONE step -----------------------------------
+one = jax.jit(lambda st, x, y: train_step(0, st, x, y))
+compiled = one.lower((params, m0, v0, bstats), x, y).compile()
+ca = compiled.cost_analysis()
+ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+flops = float(ca.get("flops", 0.0))
+bytes_acc = float(ca.get("bytes accessed", 0.0))
+
+result = {"backend": backend, "chip": gen if ON_TPU else "cpu",
+          "batch": batch, "px": hw,
+          "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
+          "xla_flops_per_step": flops,
+          "xla_bytes_per_step": bytes_acc}
+
+# --- measured step time --------------------------------------------------
+floor_s = measure_fetch_floor()
+iters = 10 if ON_TPU else 2
+ms = timed_steps(train_step, (params, m0, v0, bstats), iters=iters,
+                 consts=(x, y), floor_s=floor_s)
+result["measured_step_ms"] = round(ms, 2)
+result["imgs_per_sec"] = round(batch / (ms / 1e3), 1)
+
+peak_flops = chip["tflops"] * 1e12
+peak_bw = chip["hbm_gbps"] * 1e9
+t_flops_ms = flops / peak_flops * 1e3
+t_bytes_ms = bytes_acc / peak_bw * 1e3
+roofline_ms = max(t_flops_ms, t_bytes_ms)
+result["roofline"] = {
+    "t_mxu_ms": round(t_flops_ms, 2), "t_hbm_ms": round(t_bytes_ms, 2),
+    "bound": "mxu" if t_flops_ms > t_bytes_ms else "hbm",
+    "ideal_ms": round(roofline_ms, 2),
+    "achieved_frac": round(roofline_ms / ms, 3) if ms > 0 else 0.0,
+    "mxu_frac": round(t_flops_ms / ms, 3),
+    "hbm_frac": round(t_bytes_ms / ms, 3),
+}
+
+# --- best-effort device trace -------------------------------------------
+trace_dir = os.path.join(ROOT, "traces", "resnet50")
+try:
+    os.makedirs(trace_dir, exist_ok=True)
+    st = (params, m0, v0, bstats)
+    with jax.profiler.trace(trace_dir):
+        for i in range(3):
+            st = one(st, x, y)
+        jax.block_until_ready(st)
+    files = []
+    for dp, _, fn in os.walk(trace_dir):
+        files += [os.path.join(os.path.relpath(dp, ROOT), f) for f in fn]
+    result["trace"] = {"ok": True, "files": files[:20]}
+except Exception as e:
+    result["trace"] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+out = os.path.join(ROOT, "RESNET50_ROOFLINE.json" if ON_TPU
+                   else "RESNET50_ROOFLINE_SMOKE.json")
+bench.atomic_write_json(out, result)
+print(json.dumps({k: result[k] for k in
+                  ("measured_step_ms", "imgs_per_sec", "roofline")}))
+if not ON_TPU:
+    raise AssertionError("roofline ran on CPU")
