@@ -47,17 +47,20 @@ class AsyncSaveHandle:
         self._ckptr.close()
 
     def __del__(self):
+        # warn ONLY: running the unbounded blocking flush from a finalizer
+        # could stall whatever thread happens to trigger collection (or
+        # interpreter shutdown) indefinitely, and a flush failure here
+        # would be silently swallowed anyway. The caller owns durability;
+        # a dropped handle means an unverified checkpoint, and the warning
+        # says so.
         if not self._done:
             import warnings
 
             warnings.warn(
                 f"AsyncSaveHandle for {self._path!r} was never wait()ed — "
-                "the checkpoint may be incomplete on disk",
+                "the checkpoint may be incomplete on disk; call wait() "
+                "before dropping the handle",
                 RuntimeWarning, stacklevel=2)
-            try:
-                self.wait()
-            except Exception:
-                pass
 
 
 def save_async(path: str, tree: Any) -> AsyncSaveHandle:

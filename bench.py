@@ -52,10 +52,23 @@ _CHIP = {
 _A100_GBPS = 1555e9 * 0.85  # apex multi_tensor kernels reach ~85% of peak
 
 
+# timed-out probe children, left to finish on their own (reaped lazily)
+_orphan_probes = []
+
+
 def _probe_once(seconds: int) -> bool:
-    """One subprocess backend probe under a hard timeout. The probe is
-    PRE-claim (it only asks for the default backend) so terminating it on
-    timeout cannot wedge the relay; SIGTERM first so it can unwind."""
+    """One subprocess backend probe under a hard timeout.
+
+    CAUTION: the probe is NOT claim-free — the axon sitecustomize
+    initializes the TPU client on ANY backend request, so a timed-out
+    probe may itself hold a partial claim. A hung child is blocked in C
+    (SIGTERM's handler would never run — and if it DID land mid-claim it
+    would wedge the relay for hours, the exact failure this module exists
+    to survive). So on timeout we send NO signal and do NOT block: orphan
+    the child to finish at its own pace, return False, and keep the
+    caller's deadline live."""
+    # reap any earlier orphans that have since finished
+    _orphan_probes[:] = [p for p in _orphan_probes if p.poll() is None]
     proc = subprocess.Popen(
         [sys.executable, "-c",
          "import jax; print(jax.default_backend())"],
@@ -63,11 +76,7 @@ def _probe_once(seconds: int) -> bool:
     try:
         return proc.wait(timeout=seconds) == 0
     except subprocess.TimeoutExpired:
-        proc.terminate()
-        try:
-            proc.wait(timeout=30)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+        _orphan_probes.append(proc)
         return False
 
 
