@@ -52,6 +52,17 @@ def _pick_rows(skp: int, sq: int, itemsize: int = 4,
     return min(br, _round_up(sq, 8))
 
 
+def _softmax_rows_f32(x32):
+    """Row softmax on a masked fp32 tile. Reciprocal-multiply (one divide
+    per ROW, then a row-broadcast mul) instead of a per-element divide;
+    fully-masked rows (max == fill) output zeros,
+    scaled_masked_softmax.h:297. Shared by every forward kernel."""
+    m = jnp.max(x32, axis=-1, keepdims=True)
+    e = jnp.exp(x32 - m)
+    s = jnp.sum(e, axis=-1, keepdims=True)
+    return e * jnp.where(m <= MASK_FILL, 0.0, 1.0 / s)
+
+
 def _sm_fwd_kernel(*refs, scale, causal, has_mask, sk_orig, br, skp):
     if has_mask:
         x_ref, m_ref, o_ref = refs
@@ -69,14 +80,7 @@ def _sm_fwd_kernel(*refs, scale, causal, has_mask, sk_orig, br, skp):
     if skp != sk_orig:
         cols = jax.lax.broadcasted_iota(jnp.int32, (br, skp), 1)
         x32 = jnp.where(cols >= sk_orig, MASK_FILL, x32)
-    m = jnp.max(x32, axis=-1, keepdims=True)
-    e = jnp.exp(x32 - m)
-    s = jnp.sum(e, axis=-1, keepdims=True)
-    # reciprocal-multiply (one divide per ROW, then a row-broadcast mul)
-    # instead of a per-element divide; fully-masked row (max == fill) →
-    # zeros, scaled_masked_softmax.h:297
-    y = e * jnp.where(m <= MASK_FILL, 0.0, 1.0 / s)
-    o_ref[0] = y.astype(o_ref.dtype)
+    o_ref[0] = _softmax_rows_f32(x32).astype(o_ref.dtype)
 
 
 def _sm_bwd_kernel(y_ref, dy_ref, dx_ref, *, scale):
@@ -84,6 +88,73 @@ def _sm_bwd_kernel(y_ref, dy_ref, dx_ref, *, scale):
     dy32 = dy_ref[0].astype(_f32)
     c = jnp.sum(dy32 * y32, axis=-1, keepdims=True)
     dx_ref[0] = ((dy32 - c) * y32 * scale).astype(dx_ref.dtype)
+
+
+def _sm_causal_chunked_kernel(x_ref, o_ref, xbuf, *, scale, sk_orig, br, bc,
+                              skp, nc):
+    """Causal forward with column-chunked fetch: chunk j of row block qi is
+    DMA'd from HBM only when it intersects the lower triangle (the index
+    map aliases above-diagonal chunks to the last needed one, and Mosaic
+    skips the copy when the block index repeats) — on causal scores ~25%
+    of the input bytes never leave HBM. Chunks are staged into a
+    row-complete VMEM buffer; the softmax itself runs once per row block
+    at the last chunk."""
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    limit = ((qi + 1) * br - 1) // bc  # last chunk touching the triangle
+
+    @pl.when(j <= limit)
+    def _stage():
+        xbuf[:, pl.ds(j * bc, bc)] = x_ref[0].astype(_f32)
+
+    @pl.when(j == nc - 1)
+    def _softmax():
+        rows = jax.lax.broadcasted_iota(jnp.int32, (br, skp), 0) + qi * br
+        cols = jax.lax.broadcasted_iota(jnp.int32, (br, skp), 1)
+        # one mask covers the diagonal straddle, the never-staged region
+        # (whose xbuf content is stale garbage — replaced, not arithmetic,
+        # so NaN/Inf there cannot leak), and key padding
+        keep = (cols <= rows) & (cols < sk_orig)
+        x32 = jnp.where(keep, xbuf[...] * scale, MASK_FILL)
+        o_ref[0] = _softmax_rows_f32(x32).astype(o_ref.dtype)
+
+
+def _softmax_fwd_causal_chunked(x3, *, scale, interpret):
+    B, sq, sk = x3.shape
+    skp = _round_up(sk, 128)
+    br = _pick_rows(skp, sq, x3.dtype.itemsize, False)
+    sqp = _round_up(sq, br)
+    # largest chunk that still gives >= 2 chunks; with one row block or one
+    # chunk nothing can ever be skipped — signal the caller to use the
+    # plain row-complete kernel instead of paying the staging overhead
+    bc = next((c for c in (512, 256, 128) if skp % c == 0 and skp > c),
+              None)
+    if bc is None or sqp // br < 2:
+        return None
+    nc = skp // bc
+    xp = jnp.pad(x3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+
+    def x_idx(b, i, j):
+        limit = ((i + 1) * br - 1) // bc
+        return (b, i, jnp.minimum(j, limit))
+
+    out = pl.pallas_call(
+        functools.partial(_sm_causal_chunked_kernel, scale=scale,
+                          sk_orig=sk, br=br, bc=bc, skp=skp, nc=nc),
+        grid=(B, sqp // br, nc),
+        in_specs=[pl.BlockSpec((1, br, bc), x_idx,
+                               memory_space=pltpu.VMEM)],
+        # the output block ignores j: written once (at the last chunk) and
+        # flushed when the row-block index advances
+        out_specs=pl.BlockSpec((1, br, skp), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((B, sqp, skp), x3.dtype),
+        scratch_shapes=[pltpu.VMEM((br, skp), _f32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(xp)
+    return out[:, :sq, :sk]
 
 
 def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
@@ -95,6 +166,14 @@ def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
         interpret = interpret_default()
     B, sq, sk = x3.shape
     skp = _round_up(sk, 128)
+    if causal and mask3 is None and skp >= 256 and sq >= 16:
+        # chunked fetch pays only when >= 2 column chunks AND >= 2 row
+        # blocks exist (so upper-triangle chunks can actually be skipped);
+        # the helper returns None for degenerate shapes
+        out = _softmax_fwd_causal_chunked(x3, scale=scale,
+                                          interpret=interpret)
+        if out is not None:
+            return out
     br = _pick_rows(skp, sq, x3.dtype.itemsize, mask3 is not None)
     sqp = _round_up(sq, br)
     xp = jnp.pad(x3, ((0, 0), (0, sqp - sq), (0, skp - sk)))

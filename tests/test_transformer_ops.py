@@ -320,6 +320,19 @@ class TestPallasSoftmaxKernel:
         np.testing.assert_allclose(np.asarray(y),
                                    self._ref(x, None, 1.3, True), atol=1e-6)
 
+    @pytest.mark.parametrize("sq", [512, 640, 300])
+    def test_fwd_causal_chunked_fetch(self, sq):
+        """The chunked-fetch causal path (column chunks above the diagonal
+        never staged; stale-scratch region masked before the exp) must be
+        bit-faithful to the row-complete reference at multi-row-block,
+        multi-chunk shapes, including non-128-multiple lengths."""
+        from apex_tpu.ops.pallas.softmax_kernel import softmax_fwd_pallas
+        x = jax.random.normal(jax.random.PRNGKey(7), (2, sq, sq))
+        y = softmax_fwd_pallas(x, None, scale=0.7, causal=True,
+                               interpret=True)
+        np.testing.assert_allclose(np.asarray(y),
+                                   self._ref(x, None, 0.7, True), atol=1e-6)
+
     @pytest.mark.parametrize("bm,h", [(6, 1), (1, 1), (2, 3)])
     def test_fwd_mask_broadcast(self, bm, h):
         """(b, 1, sq, sk)-style mask sharing across h heads, flattened."""
