@@ -359,7 +359,9 @@ def bench_bert_lamb(jax, jnp, on_tpu, chip, floor_s):
     from apex_tpu.utils.benchtime import timed_steps
 
     if on_tpu:
-        cfg, batch, seq = BertConfig.large(), 8, 128
+        # b32 keeps every matmul MXU-shaped (b8 left the 1024-wide GEMMs
+        # M-starved at s128); metric name records the config
+        cfg, batch, seq = BertConfig.large(), 32, 128
     else:
         cfg, batch, seq = BertConfig.tiny(), 2, 32
     model = Bert(cfg)

@@ -1,0 +1,74 @@
+"""Chip job: fused-Adam flat-kernel block sweep at the 1B headline shape.
+
+The headline metric sits at 0.80 HBM frac with 512-row blocks; this sweeps
+the streaming block size to find the bandwidth knee. One JSON line per
+config appended to tools/tune_adam.out.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if jax.default_backend() != "tpu" and \
+        os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError("backend is not tpu")
+
+from apex_tpu.ops.pallas.fused_adam_kernel import (LANE,  # noqa: E402
+                                                   fused_adam_flat)
+from apex_tpu.utils.benchtime import (measure_fetch_floor,  # noqa: E402
+                                      timed_steps)
+
+ON_TPU = jax.default_backend() == "tpu"
+gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+peak_gbps = {"v5e": 819.0, "v6e": 1640.0, "v5p": 2765.0}.get(gen, 819.0)
+n = 999_999_488 if ON_TPU else 1_048_576
+rows = n // LANE
+floor_s = measure_fetch_floor()
+
+out_path = os.path.join(ROOT, "tools", "tune_adam.out")
+best = None
+with open(out_path, "a") as out:
+    print(f"# backend={jax.default_backend()} n={n}", file=out, flush=True)
+    for br in ([256, 512, 1024, 2048, 4096] if ON_TPU else [512]):
+        p = jax.random.normal(jax.random.PRNGKey(0), (rows, LANE),
+                              jnp.bfloat16) * 0.02
+        g = jax.random.normal(jax.random.PRNGKey(1), (rows, LANE),
+                              jnp.bfloat16)
+        m = jnp.zeros((rows, LANE), jnp.float32)
+        v = jnp.zeros((rows, LANE), jnp.float32)
+
+        def step(i, st, g, br=br):
+            p, m, v = st
+            return fused_adam_flat(p, g, m, v, lr=1e-3, weight_decay=0.01,
+                                   step=i + 1, inv_scale=1.0,
+                                   block_rows=br)
+
+        try:
+            t0 = time.time()
+            ms = timed_steps(step, (p, m, v), iters=30 if ON_TPU else 2,
+                             consts=(g,), floor_s=floor_s)
+            frac = n * 22 / (ms / 1e3) / 1e9 / peak_gbps
+            rec = {"block_rows": br, "ms": round(ms, 3),
+                   "hbm_frac": round(frac, 3),
+                   "wall_s": round(time.time() - t0, 1)}
+            print(json.dumps(rec), file=out, flush=True)
+            if best is None or rec["hbm_frac"] > best["hbm_frac"]:
+                best = rec
+        except Exception as e:
+            print(json.dumps({"block_rows": br,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  file=out, flush=True)
+        finally:
+            del p, g, m, v
+    print(json.dumps({"best": best}), file=out, flush=True)
+if best is None:
+    raise AssertionError("no successful config")
