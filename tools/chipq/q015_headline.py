@@ -1,0 +1,41 @@
+"""Chip job: capture ONLY the headline fused-Adam@1B row, fast.
+
+Insurance for a late-returning relay: lands a real TPU record in
+BENCH_TPU_CACHE.json minutes after acquisition (complete=false — the full
+q020 suite overwrites it). bench.py's worker-poll path accepts a partial
+capture at its deadline, so even a worker still mid-suite at driver time
+yields a TPU-backed headline.
+"""
+
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bench  # noqa: E402
+
+backend = jax.default_backend()
+if backend != "tpu" and os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError(f"backend={backend}")
+
+from apex_tpu.utils.benchtime import measure_fetch_floor  # noqa: E402
+
+gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+chip = bench._CHIP.get(gen, bench._CHIP["v5e"])
+floor_s = measure_fetch_floor()
+entry = bench.bench_fused_adam(jax, jnp, backend == "tpu", chip, floor_s)
+suite = {"backend": backend, "chip": gen, "complete": False,
+         "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
+         "note": "headline-only early capture (q015); q020 overwrites",
+         "fused_adam_1b": entry}
+out = os.path.join(ROOT, "BENCH_TPU_CACHE.json" if backend == "tpu"
+                   else "BENCH_SMOKE_HEADLINE.json")
+bench.atomic_write_json(out, suite)
+print(entry)
