@@ -1,0 +1,105 @@
+"""bench.py's cache-first reporter logic — the round-acceptance path.
+
+These tests pin the wedge-proofing contracts: a stale or incomplete or
+CPU capture must never be emitted as a TPU record, and worker detection
+must not be fooled by a dead pid or a foreign process.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+import bench
+
+
+@pytest.fixture()
+def cache(tmp_path, monkeypatch):
+    path = tmp_path / "BENCH_TPU_CACHE.json"
+    monkeypatch.setattr(bench, "_CACHE", str(path))
+    return path
+
+
+def _suite(**over):
+    s = {"backend": "tpu", "chip": "v5e", "complete": True,
+         "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
+         "git": "abc1234",
+         "fused_adam_1b": {"metric": "m", "value": 1.0, "unit": "ms",
+                           "vs_baseline": 1.2}}
+    s.update(over)
+    return s
+
+
+class TestLoadCache:
+    def test_accepts_fresh_complete_tpu(self, cache):
+        cache.write_text(json.dumps(_suite()))
+        assert bench._load_cache() is not None
+
+    def test_rejects_cpu_backend(self, cache):
+        cache.write_text(json.dumps(_suite(backend="cpu")))
+        assert bench._load_cache() is None
+
+    def test_rejects_incomplete_unless_asked(self, cache):
+        cache.write_text(json.dumps(_suite(complete=False)))
+        assert bench._load_cache() is None
+        assert bench._load_cache(require_complete=False) is not None
+
+    def test_rejects_stale_capture(self, cache):
+        old = time.strftime("%Y-%m-%dT%H:%M:%S",
+                            time.localtime(time.time() - 15 * 3600))
+        cache.write_text(json.dumps(_suite(captured=old)))
+        assert bench._load_cache() is None
+
+    def test_rejects_missing_captured_stamp(self, cache):
+        s = _suite()
+        del s["captured"]
+        cache.write_text(json.dumps(s))
+        assert bench._load_cache() is None
+
+    def test_rejects_failed_headline(self, cache):
+        cache.write_text(json.dumps(_suite(
+            fused_adam_1b={"error": "boom"})))
+        assert bench._load_cache() is None
+
+    def test_rejects_truncated_json(self, cache):
+        cache.write_text(json.dumps(_suite())[:40])
+        assert bench._load_cache() is None
+
+
+class TestWorkerAlive:
+    def _status(self, tmp_path, monkeypatch, **kw):
+        qdir = tmp_path / "tools" / "chipq"
+        qdir.mkdir(parents=True)
+        monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+        kw.setdefault("t", "now")
+        (qdir / "status.json").write_text(json.dumps(kw))
+
+    def test_dead_pid_not_alive(self, tmp_path, monkeypatch):
+        # find a free pid: fork-less heuristic, very large pids are unused
+        self._status(tmp_path, monkeypatch, pid=2 ** 22 - 3,
+                     phase="running")
+        assert not bench._worker_alive()
+
+    def test_exited_phase_not_alive(self, tmp_path, monkeypatch):
+        self._status(tmp_path, monkeypatch, pid=os.getpid(),
+                     phase="exited")
+        assert not bench._worker_alive()
+
+    def test_foreign_process_not_alive(self, tmp_path, monkeypatch):
+        # our own pid is alive but is pytest, not chip_worker
+        self._status(tmp_path, monkeypatch, pid=os.getpid(),
+                     phase="running")
+        assert not bench._worker_alive()
+
+    def test_missing_status_not_alive(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+        assert not bench._worker_alive()
+
+
+class TestAtomicWrite:
+    def test_no_partial_file_visible(self, tmp_path):
+        path = tmp_path / "x.json"
+        bench.atomic_write_json(str(path), {"a": 1})
+        assert json.load(open(path)) == {"a": 1}
+        assert not os.path.exists(str(path) + ".tmp")
