@@ -170,6 +170,34 @@ def maybe_adam_undo(params: Any, grads: Any, exp_avg: Any, exp_avg_sq: Any,
     return unflat(0), unflat(1), unflat(2)
 
 
+def strided_check_finite(params: Any, stride: int = 1,
+                         clear_overflow_first: bool = True,
+                         overflow_flag=False):
+    """``strided_check_finite`` (fused_adam_cuda_kernel.cu:331-378): scan
+    every ``stride``-th element of the (low-precision) param copy for
+    non-finite values, returning the overflow flag. The reference uses it
+    as a cheap sampled overflow detector over ``p_copy`` between steps.
+    ``clear_overflow_first=False`` ORs into the incoming flag instead of
+    resetting it."""
+    flag = jnp.asarray(False if clear_overflow_first else overflow_flag)
+    for p in jax.tree_util.tree_leaves(params):
+        sampled = p.reshape(-1)[::stride].astype(jnp.float32)
+        flag = flag | jnp.any(~jnp.isfinite(sampled))
+    return flag
+
+
+def maybe_cast(params_in: Any, params_out: Any, overflow_flag=False):
+    """``maybe_cast`` / ``maybe_cast_mt`` (fused_adam_cuda_kernel.cu:381-
+    418): cast ``params_in`` into ``params_out``'s dtypes UNLESS the
+    overflow flag is set (the kernel early-outs, leaving ``p_out``
+    untouched — the master->model copy-out is skipped on overflowed
+    steps). Returns the new ``params_out`` tree."""
+    flag = jnp.asarray(overflow_flag)
+    return jax.tree_util.tree_map(
+        lambda pi, po: jnp.where(flag, po, pi.astype(po.dtype)),
+        params_in, params_out)
+
+
 class FusedAdam:
     def __init__(self, params: Any, lr: float = 1e-3,
                  bias_correction: bool = True, betas=(0.9, 0.999),

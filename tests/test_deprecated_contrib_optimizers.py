@@ -362,3 +362,32 @@ class TestReversibleAdamUndo:
         opt.undo_step([g * 2], grad_norms=gnorm * 2)
         np.testing.assert_allclose(_np(opt.parameters[0]), snap,
                                    rtol=2e-6, atol=2e-6)
+
+
+class TestCheckFiniteMaybeCast:
+    """strided_check_finite + maybe_cast (fused_adam_cuda_kernel.cu:331-418)."""
+
+    def test_strided_check_finite(self):
+        from apex_tpu.contrib.optimizers.fused_adam import \
+            strided_check_finite
+        p = jnp.ones((64,))
+        assert not bool(strided_check_finite([p]))
+        bad = p.at[7].set(jnp.nan)
+        assert bool(strided_check_finite([bad]))
+        # stride 4 skips index 7 -> clean sample
+        assert not bool(strided_check_finite([bad], stride=4))
+        # index 8 lands on the stride-4 grid
+        assert bool(strided_check_finite([p.at[8].set(jnp.inf)], stride=4))
+        # OR semantics without clear
+        assert bool(strided_check_finite([p], clear_overflow_first=False,
+                                         overflow_flag=True))
+
+    def test_maybe_cast(self):
+        from apex_tpu.contrib.optimizers.fused_adam import maybe_cast
+        pin = [jnp.arange(8, dtype=jnp.float32) * 0.1]
+        pout = [jnp.zeros(8, jnp.bfloat16)]
+        got = maybe_cast(pin, pout, overflow_flag=False)
+        assert got[0].dtype == jnp.bfloat16
+        np.testing.assert_allclose(_np(got[0]), _np(pin[0]), rtol=1e-2)
+        kept = maybe_cast(pin, pout, overflow_flag=True)
+        np.testing.assert_array_equal(_np(kept[0]), _np(pout[0]))
