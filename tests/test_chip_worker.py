@@ -1,0 +1,68 @@
+"""Queue mechanics of tools/chip_worker.py (round-acceptance infra).
+
+Tests drive the pure parts (fail counting, module purging, status writes)
+without initializing any backend.
+"""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import chip_worker  # noqa: E402
+
+
+@pytest.fixture()
+def qdirs(tmp_path, monkeypatch):
+    q = tmp_path / "chipq"
+    done = q / "done"
+    failed = q / "failed"
+    for d in (q, done, failed):
+        d.mkdir(parents=True)
+    monkeypatch.setattr(chip_worker, "QDIR", str(q))
+    monkeypatch.setattr(chip_worker, "DONE", str(done))
+    monkeypatch.setattr(chip_worker, "FAILED", str(failed))
+    monkeypatch.setattr(chip_worker, "STATUS", str(q / "status.json"))
+    return q, done, failed
+
+
+class TestFailCount:
+    def test_counts_only_own_markers(self, qdirs):
+        _, _, failed = qdirs
+        (failed / "q010_x.py.1.json").write_text("{}")
+        (failed / "q010_x.py.2.json").write_text("{}")
+        (failed / "q020_y.py.1.json").write_text("{}")
+        assert chip_worker._fail_count("q010_x.py") == 2
+        assert chip_worker._fail_count("q020_y.py") == 1
+        assert chip_worker._fail_count("q030_z.py") == 0
+
+    def test_missing_dir_is_zero(self, qdirs, monkeypatch):
+        monkeypatch.setattr(chip_worker, "FAILED",
+                            str(qdirs[0] / "nonexistent"))
+        assert chip_worker._fail_count("q010_x.py") == 0
+
+
+class TestPurge:
+    def test_purges_repo_modules_not_thirdparty(self):
+        import bench  # noqa: F401  (repo module; should be purged)
+        assert "bench" in sys.modules
+        before_np = sys.modules.get("numpy")
+        chip_worker.purge_repo_modules()
+        assert "bench" not in sys.modules
+        assert not any(m == "apex_tpu" or m.startswith("apex_tpu.")
+                       for m in sys.modules)
+        assert sys.modules.get("numpy") is before_np
+        importlib.import_module("bench")  # restore for other tests
+
+
+class TestStatus:
+    def test_status_write_atomic_and_stamped(self, qdirs):
+        chip_worker.write_status(phase="testing", backend="cpu")
+        st = json.load(open(chip_worker.STATUS))
+        assert st["phase"] == "testing"
+        assert st["pid"] == os.getpid()
+        assert "t" in st
+        assert not os.path.exists(chip_worker.STATUS + ".tmp")
