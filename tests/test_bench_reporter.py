@@ -87,9 +87,10 @@ class TestWorkerAlive:
         assert not bench._worker_alive()
 
     def test_foreign_process_not_alive(self, tmp_path, monkeypatch):
-        # our own pid is alive but is pytest, not chip_worker
-        self._status(tmp_path, monkeypatch, pid=os.getpid(),
-                     phase="running")
+        # pid 1 is alive but is the init process, not chip_worker (our own
+        # pid would be unusable here: the pytest cmdline itself contains
+        # "test_chip_worker.py")
+        self._status(tmp_path, monkeypatch, pid=1, phase="running")
         assert not bench._worker_alive()
 
     def test_missing_status_not_alive(self, tmp_path, monkeypatch):
