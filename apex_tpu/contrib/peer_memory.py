@@ -9,11 +9,22 @@ chip-to-chip one-sided writes are what ``ppermute`` compiles to over ICI
 (SURVEY §5 comm backend mapping). ``PeerMemoryPool`` therefore carries only
 the bookkeeping surface (sizes/alignment) so reference call sites port
 mechanically, and the halo exchanger delegates to apex_tpu.parallel.halo.
+
+``transport="rdma"`` routes the exchange through an explicit Pallas
+one-sided remote DMA (``ops/pallas/remote_copy.halo_exchange_rdma``) —
+the literal TPU analog of the reference's peer put
+(``push_pull_halos_1d``, peer_memory.cpp:20-34): a kernel-issued ICI put
+into the neighbor's buffer, semaphore-synchronized, no collective. The
+default ``transport="collective"`` keeps the compiler-scheduled
+``ppermute`` path.
 """
 
 from __future__ import annotations
 
 from typing import Optional
+
+import jax
+import jax.numpy as jnp
 
 from apex_tpu.parallel.halo import halo_exchange_1d, left_right_halo_exchange
 
@@ -32,23 +43,64 @@ class PeerMemoryPool:
     def allocate_peer_tensors(self, shape, dtype, channels_last: bool,
                               dynamic: bool):
         raise NotImplementedError(
-            "TPU has no user-managed peer memory: use "
-            "apex_tpu.parallel.halo (ppermute lowers to direct ICI DMA).")
+            "TPU has no user-managed peer memory: the peer-put CAPABILITY "
+            "is PeerHaloExchanger1d(transport='rdma') (a Pallas one-sided "
+            "remote DMA), or apex_tpu.parallel.halo's ppermute path.")
 
 
 class PeerHaloExchanger1d:
-    """≈ peer_halo_exchanger_1d.PeerHaloExchanger1d — ppermute-backed."""
+    """≈ peer_halo_exchanger_1d.PeerHaloExchanger1d.
+
+    ``transport="collective"`` (default): ppermute-backed.
+    ``transport="rdma"``: Pallas one-sided remote-DMA puts — the
+    reference's actual mechanism (peer rank writes directly into this
+    rank's buffer)."""
 
     def __init__(self, ranks=None, rank_in_group: Optional[int] = None,
                  peer_pool: Optional[PeerMemoryPool] = None,
-                 half_halo: int = 1, axis_name: str = "spatial"):
+                 half_halo: int = 1, axis_name: str = "spatial",
+                 transport: str = "collective"):
+        if transport not in ("collective", "rdma"):
+            raise ValueError(f"unknown transport {transport!r}")
         self.axis_name = axis_name
         self.half_halo = half_halo
+        self.transport = transport
 
     def left_right_halo_exchange(self, left_output_halo, right_output_halo):
+        if self.transport == "rdma":
+            from apex_tpu.ops.pallas.remote_copy import halo_exchange_rdma
+
+            # stack my two edges so one kernel moves both directions, then
+            # split: lo is what arrived from the left neighbor
+            h = left_output_halo.shape[0]
+            if right_output_halo.shape[0] != h:
+                raise ValueError(
+                    "rdma transport exchanges symmetric halos; got "
+                    f"{h} vs {right_output_halo.shape[0]} rows — use "
+                    "transport='collective' for asymmetric strips")
+            both = jnp.concatenate([left_output_halo, right_output_halo], 0)
+            lo, hi = halo_exchange_rdma(both, self.axis_name, h)
+            return lo, hi
         return left_right_halo_exchange(left_output_halo, right_output_halo,
                                         self.axis_name)
 
     def __call__(self, x, spatial_axis: int = 1):
+        if self.transport == "rdma":
+            from apex_tpu.ops.pallas.remote_copy import halo_exchange_rdma
+
+            # exchange only the edge STRIPS — moveaxis on (2·halo, ...)
+            # strips is cheap; relayouting the full activation twice on the
+            # hot conv path is not
+            h = self.half_halo
+            size = x.shape[spatial_axis]
+            top = jax.lax.slice_in_dim(x, 0, h, axis=spatial_axis)
+            bottom = jax.lax.slice_in_dim(x, size - h, size,
+                                          axis=spatial_axis)
+            both = jnp.concatenate([top, bottom], axis=spatial_axis)
+            both = jnp.moveaxis(both, spatial_axis, 0)
+            lo, hi = halo_exchange_rdma(both, self.axis_name, h)
+            lo = jnp.moveaxis(lo, 0, spatial_axis)
+            hi = jnp.moveaxis(hi, 0, spatial_axis)
+            return jnp.concatenate([lo, x, hi], axis=spatial_axis)
         return halo_exchange_1d(x, self.half_halo, self.axis_name,
                                 spatial_axis)

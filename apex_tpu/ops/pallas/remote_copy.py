@@ -1,0 +1,116 @@
+"""Peer-to-peer device copies — Pallas TPU remote DMA.
+
+The TPU materialization of the reference's peer-memory machinery
+(``apex/contrib/peer_memory/peer_memory.py`` raw IPC buffers +
+``peer_halo_exchanger_1d.py`` direct puts, and the ``nccl_p2p`` send/recv
+pairs): ``pltpu.make_async_remote_copy`` issues a one-sided RDMA put over
+ICI from this chip's buffer into a neighbor's, synchronized by DMA
+semaphores — no collective, no host involvement. This is the same
+hardware path XLA's ``ppermute`` lowers to, exposed as a kernel so halo
+payloads can move while the surrounding kernel computes (the latency
+hiding the reference's peer pools exist for).
+
+Used by ``contrib.peer_memory`` / ``parallel.halo`` as the opt-in
+``transport="rdma"`` path; the default XLA-collective path remains for
+callers that prefer compiler-scheduled comm. Both are parity-tested
+against each other on the virtual CPU mesh (interpret mode executes the
+remote copies faithfully).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.utils.env import interpret_default
+
+
+def _shift_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name, shift):
+    my = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    dst = jax.lax.rem(my + shift + n, n)
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=x_ref, dst_ref=o_ref, send_sem=send_sem, recv_sem=recv_sem,
+        device_id=dst, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    rdma.start()
+    rdma.wait()
+
+
+def peer_shift(x: jax.Array, axis_name: str, shift: int = 1,
+               interpret: bool | None = None) -> jax.Array:
+    """Ring-shift ``x`` by ``shift`` positions along ``axis_name`` via a
+    one-sided RDMA put (each device receives the shard of the device
+    ``shift`` places behind it). Call inside ``shard_map``. Equivalent to
+    ``jax.lax.ppermute`` with the ring permutation — implemented as an
+    explicit peer copy, the ``nccl_p2p.nccl_send``/``nccl_recv`` pair."""
+    if interpret is None:
+        interpret = interpret_default()
+    return pl.pallas_call(
+        functools.partial(_shift_kernel, axis_name=axis_name, shift=shift),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(x)
+
+
+def _halo_kernel(x_ref, lo_ref, hi_ref, slo, shi, rlo, rhi, *,
+                 axis_name, halo):
+    """Send my low edge to the LEFT neighbor's ``hi`` buffer and my high
+    edge to the RIGHT neighbor's ``lo`` buffer (periodic ring; the wrapper
+    zeroes wrap-around halos for non-periodic semantics)."""
+    my = jax.lax.axis_index(axis_name)
+    n = jax.lax.axis_size(axis_name)
+    left = jax.lax.rem(my - 1 + n, n)
+    right = jax.lax.rem(my + 1, n)
+    # my first `halo` rows -> left neighbor's hi_ref
+    put_lo = pltpu.make_async_remote_copy(
+        src_ref=x_ref.at[pl.ds(0, halo)], dst_ref=hi_ref,
+        send_sem=slo, recv_sem=rhi,
+        device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    # my last `halo` rows -> right neighbor's lo_ref
+    put_hi = pltpu.make_async_remote_copy(
+        src_ref=x_ref.at[pl.ds(x_ref.shape[0] - halo, halo)],
+        dst_ref=lo_ref, send_sem=shi, recv_sem=rlo,
+        device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+    put_lo.start()
+    put_hi.start()
+    put_lo.wait()
+    put_hi.wait()
+
+
+def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
+                       periodic: bool = False,
+                       interpret: bool | None = None):
+    """1-D halo exchange over leading axis via peer RDMA puts: returns
+    ``(lo, hi)`` — the ``halo`` rows received from the left and right
+    neighbors (≈ ``PeerHaloExchanger1d`` over a ``PeerMemoryPool``,
+    peer_halo_exchanger_1d.py). ``periodic=False`` zeroes the wrap-around
+    halos at the ring edges, matching the halo exchangers' boundary
+    convention in ``parallel.halo``."""
+    if interpret is None:
+        interpret = interpret_default()
+    lo, hi = pl.pallas_call(
+        functools.partial(_halo_kernel, axis_name=axis_name, halo=halo),
+        out_shape=[
+            jax.ShapeDtypeStruct((halo,) + x.shape[1:], x.dtype),
+            jax.ShapeDtypeStruct((halo,) + x.shape[1:], x.dtype),
+        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)],
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
+                        pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        interpret=interpret,
+    )(x)
+    if not periodic:
+        idx = jax.lax.axis_index(axis_name)
+        n = jax.lax.axis_size(axis_name)
+        lo = jnp.where(idx == 0, jnp.zeros_like(lo), lo)
+        hi = jnp.where(idx == n - 1, jnp.zeros_like(hi), hi)
+    return lo, hi
