@@ -69,10 +69,24 @@ def _merge(o1, lse1, o2, lse2):
     return o, lse
 
 
+
+def _rotate(x, axis_name, perm, transport):
+    """One +1 ring hop of ``x``. ``transport="rdma"`` issues the Pallas
+    one-sided remote-DMA put (ops/pallas/remote_copy.peer_shift — an
+    explicit peer copy over ICI); the default stays the compiler-scheduled
+    ``ppermute``. Numerics are identical (parity-tested)."""
+    if transport == "rdma":
+        from apex_tpu.ops.pallas.remote_copy import peer_shift
+
+        return peer_shift(x, axis_name, 1)
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
 # ------------------------------------------------------- contiguous layout
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k):
+def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
+                   transport="collective"):
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
 
@@ -86,8 +100,8 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k):
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
         # rotate K/V one hop around the ring
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_cur = _rotate(k_cur, axis_name, perm, transport)
+        v_cur = _rotate(v_cur, axis_name, perm, transport)
         # after `step+1` hops I hold the shard of device (my - step - 1) mod n
         src = (my - step - 1) % n
         o_i, lse_i = flash_attention_fwd(q, k_cur, v_cur, scale=s,
@@ -106,11 +120,12 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k):
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                         axis_name: str, causal: bool = False,
                         scale: Optional[float] = None,
-                        block_q: int = 128, block_k: int = 128) -> jax.Array:
+                        block_q: int = 128, block_k: int = 128,
+                        transport: str = "collective") -> jax.Array:
     """Ring attention over the ``axis_name`` mesh axis.
 
     q/k/v: LOCAL shards (b, h, s_local, d) of a sequence sharded contiguously
@@ -119,17 +134,21 @@ def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     For causal long-context training prefer ``zigzag_ring_self_attention``.
     """
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k)
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
+                          transport)
     return o
 
 
-def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
+def _ring_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                  transport):
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k)
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
+                            transport)
     return o, (q, k, v, o, lse)
 
 
-def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, do):
+def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
+                  res, do):
     q, k, v, o, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     n = jax.lax.axis_size(axis_name)
@@ -148,10 +167,10 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, do):
     def body(carry, step):
         dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
         # rotate the shard AND its gradient accumulators together
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        k_cur = _rotate(k_cur, axis_name, perm, transport)
+        v_cur = _rotate(v_cur, axis_name, perm, transport)
+        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
+        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
         src = (my - step - 1) % n
         dq_j, dk_j, dv_j, _ = flash_attention_bwd(
             q, k_cur, v_cur, o, lse, do, scale=s, causal=False,
@@ -172,8 +191,8 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, do):
         (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
             body, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(n - 1))
         # one final hop brings dK/dV home (n rotations total = identity)
-        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
+        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
     return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 
@@ -216,7 +235,8 @@ def zigzag_unshard(x, n: int, axis: int = 2):
     return jnp.concatenate(inv, axis=axis)
 
 
-def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k):
+def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k,
+                 transport="collective"):
     """Causal zigzag ring forward. Local layout: [low chunk, high chunk]."""
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -253,8 +273,8 @@ def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k):
 
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_cur = _rotate(k_cur, axis_name, perm, transport)
+        v_cur = _rotate(v_cur, axis_name, perm, transport)
         src = (my - step - 1) % n
         o_i, lse_i = jax.lax.cond(src < my, step_earlier, step_later,
                                   k_cur, v_cur)
@@ -267,12 +287,13 @@ def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k):
     return o.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def zigzag_ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                                axis_name: str,
                                scale: Optional[float] = None,
                                block_q: int = 128,
-                               block_k: int = 128) -> jax.Array:
+                               block_k: int = 128,
+                               transport: str = "collective") -> jax.Array:
     """Causal ring attention in the balanced zigzag layout.
 
     q/k/v: LOCAL shards (b, h, s_local, d) where the GLOBAL sequence was
@@ -282,17 +303,18 @@ def zigzag_ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     for non-causal use ``ring_self_attention`` (already balanced).
     """
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    o, _ = _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k)
+    o, _ = _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k, transport)
     return o
 
 
-def _zz_vjp_fwd(q, k, v, axis_name, scale, block_q, block_k):
+def _zz_vjp_fwd(q, k, v, axis_name, scale, block_q, block_k, transport):
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    o, lse = _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k)
+    o, lse = _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k,
+                          transport)
     return o, (q, k, v, o, lse)
 
 
-def _zz_vjp_bwd(axis_name, scale, block_q, block_k, res, do):
+def _zz_vjp_bwd(axis_name, scale, block_q, block_k, transport, res, do):
     q, k, v, o, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     n = jax.lax.axis_size(axis_name)
@@ -329,10 +351,10 @@ def _zz_vjp_bwd(axis_name, scale, block_q, block_k, res, do):
 
     def body(carry, step):
         dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
-        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        k_cur = _rotate(k_cur, axis_name, perm, transport)
+        v_cur = _rotate(v_cur, axis_name, perm, transport)
+        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
+        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
         src = (my - step - 1) % n
         dq_j, dk_j, dv_j = jax.lax.cond(src < my, bwd_earlier, bwd_later,
                                         k_cur, v_cur)
@@ -342,8 +364,8 @@ def _zz_vjp_bwd(axis_name, scale, block_q, block_k, res, do):
     if n > 1:
         (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
             body, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(n - 1))
-        dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
-        dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
+        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
     return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 

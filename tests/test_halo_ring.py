@@ -334,3 +334,46 @@ class TestRdmaTransport:
             outs[transport] = [np.asarray(a) for a in body(lo, hi)]
         np.testing.assert_array_equal(outs["collective"][0], outs["rdma"][0])
         np.testing.assert_array_equal(outs["collective"][1], outs["rdma"][1])
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_ring_attention_rdma_matches_collective(self, mesh, causal):
+        b, h, s, d = 1, 2, WORLD * 16, 32
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d)) * 0.3 for kk in ks)
+        outs, grads = {}, {}
+        for transport in ("collective", "rdma"):
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+                out_specs=P(None, None, "sp"), check_vma=False)
+            def body(q, k, v, transport=transport):
+                return ring_self_attention(q, k, v, "sp", causal,
+                                           transport=transport)
+
+            outs[transport] = np.asarray(body(q, k, v))
+            grads[transport] = np.asarray(jax.grad(
+                lambda q: jnp.sum(body(q, k, v) ** 2))(q))
+        np.testing.assert_allclose(outs["collective"], outs["rdma"],
+                                   atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(grads["collective"], grads["rdma"],
+                                   atol=1e-6, rtol=1e-6)
+
+    def test_zigzag_rdma_matches_collective(self, mesh):
+        b, h, s, d = 1, 2, WORLD * 16, 32
+        ks = jax.random.split(jax.random.PRNGKey(1), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, s, d)) * 0.3 for kk in ks)
+        qz = zigzag_shard(q, WORLD)
+        kz = zigzag_shard(k, WORLD)
+        vz = zigzag_shard(v, WORLD)
+        outs = {}
+        for transport in ("collective", "rdma"):
+            @functools.partial(
+                shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+                out_specs=P(None, None, "sp"), check_vma=False)
+            def body(q, k, v, transport=transport):
+                return zigzag_ring_self_attention(q, k, v, "sp",
+                                                  transport=transport)
+
+            outs[transport] = np.asarray(jax.grad(
+                lambda q: jnp.sum(body(q, kz, vz) ** 2))(qz))
+        np.testing.assert_allclose(outs["collective"], outs["rdma"],
+                                   atol=1e-6, rtol=1e-6)
