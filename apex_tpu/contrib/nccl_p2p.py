@@ -4,9 +4,11 @@
 
 On TPU the "communicator" is the mesh axis: rendezvous is
 ``jax.distributed.initialize`` + ``Mesh`` (apex_tpu.parallel.mesh), and the
-p2p exchange is ppermute. ``add_delay`` — the reference's only
-fault-injection hook (SURVEY §5) — is kept as a real latency injector for
-halo-exchange race tests.
+p2p exchange is ppermute — or, for an explicit one-sided put matching the
+reference's send/recv pairs, the Pallas remote-DMA ``p2p_shift``
+re-exported below. ``add_delay`` — the reference's only fault-injection
+hook (SURVEY §5) — is kept as a real latency injector for halo-exchange
+race tests.
 """
 
 from __future__ import annotations
@@ -16,6 +18,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.ops.pallas.remote_copy import \
+    peer_shift as p2p_shift  # noqa: F401  (one-sided RDMA send/recv pair)
 from apex_tpu.parallel.halo import left_right_halo_exchange  # noqa: F401
 
 
