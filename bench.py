@@ -619,8 +619,7 @@ def main():
     out: every path above is bounded well under the driver's window."""
     suite = _load_cache()
     if suite is not None:
-        with open(os.path.join(_HERE, "BENCH_SUITE.json"), "w") as f:
-            json.dump(suite, f, indent=1)
+        atomic_write_json(os.path.join(_HERE, "BENCH_SUITE.json"), suite)
         _emit(suite, cached=True)
 
     # on the CPU-smoke re-exec, skip the worker poll (it already failed
@@ -633,11 +632,13 @@ def main():
             suite = _load_cache()
             if suite is not None:
                 break
+            if not _worker_alive():  # died/idle-exited: stop burning the
+                break                # driver window waiting on nothing
             time.sleep(20)
         suite = _load_cache() or _load_cache(require_complete=False)
         if suite is not None:  # accept even a partial capture at deadline
-            with open(os.path.join(_HERE, "BENCH_SUITE.json"), "w") as f:
-                json.dump(suite, f, indent=1)
+            atomic_write_json(os.path.join(_HERE, "BENCH_SUITE.json"),
+                              suite)
             _emit(suite, cached=True)
 
     if worker_was_alive and _worker_alive():
@@ -658,8 +659,7 @@ def main():
         else "BENCH_SMOKE.json")
     suite = run_suite(jax, jnp, backend, out_path=out)
     if backend == "tpu":
-        with open(os.path.join(_HERE, "BENCH_SUITE.json"), "w") as f:
-            json.dump(suite, f, indent=1)
+        atomic_write_json(os.path.join(_HERE, "BENCH_SUITE.json"), suite)
     _emit(suite, cached=False)
 
 

@@ -136,6 +136,7 @@ result["pass"] = bool(rel.mean() < 0.05 and wrel < 0.05
 _flush()
 print(json.dumps({k: result[k] for k in
                   ("mean_rel_loss_diff", "end_weight_rel_diff", "pass")}))
-if not (result["pass"] and ON_TPU):
+allow_cpu = os.environ.get("CHIPQ_ALLOW_CPU") == "1"
+if not (result["pass"] and (ON_TPU or allow_cpu)):
     raise AssertionError(f"L1 slice: pass={result['pass']} "
                          f"backend={backend}")
