@@ -1,0 +1,76 @@
+"""Chip job: causal softmax — chunked-fetch kernel vs row-complete kernel.
+
+Measures the megatron-path causal softmax at the bench shape through the
+public entry (routes to the chunked kernel) and with the chunked path
+disabled, so the round-4 DMA-elision claim is backed by an on-chip A/B.
+Appends JSON lines to tools/tune_softmax.out.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if jax.default_backend() != "tpu" and \
+        os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError("backend is not tpu")
+
+from apex_tpu.ops.pallas import softmax_kernel as sk  # noqa: E402
+from apex_tpu.utils.benchtime import (measure_fetch_floor,  # noqa: E402
+                                      timed_steps)
+
+ON_TPU = jax.default_backend() == "tpu"
+gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+peak_gbps = {"v5e": 819.0, "v6e": 1640.0, "v5p": 2765.0}.get(gen, 819.0)
+b, h, s = (8, 16, 1024) if ON_TPU else (1, 2, 256)
+iters = 50 if ON_TPU else 2
+floor_s = measure_fetch_floor()
+
+x = jax.random.normal(jax.random.PRNGKey(0), (b * h, s, s),
+                      jnp.bfloat16) * 0.1
+
+
+def run_variant(chunked: bool):
+    orig = sk._softmax_fwd_causal_chunked
+    if not chunked:
+        sk._softmax_fwd_causal_chunked = lambda *a, **k: None
+    try:
+        def step(i, x3):
+            return sk.softmax_fwd_pallas(
+                x3, None, scale=0.5, causal=True,
+                interpret=not ON_TPU).astype(x3.dtype)
+
+        return timed_steps(step, x, iters=iters, floor_s=floor_s)
+    finally:
+        sk._softmax_fwd_causal_chunked = orig
+
+
+results = []
+with open(os.path.join(ROOT, "tools", "tune_softmax.out"), "a") as out:
+    print(f"# backend={jax.default_backend()} b{b}h{h}s{s}", file=out,
+          flush=True)
+    for name, chunked in [("chunked", True), ("row_complete", False)]:
+        try:
+            t0 = time.time()
+            ms = run_variant(chunked)
+            frac = x.size * 2 * 2 / (ms / 1e3) / 1e9 / peak_gbps
+            rec = {"variant": name, "ms": round(ms, 3),
+                   "hbm_frac_full_matrix": round(frac, 3),
+                   "wall_s": round(time.time() - t0, 1)}
+            results.append(rec)
+            print(json.dumps(rec), file=out, flush=True)
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  file=out, flush=True)
+    print(json.dumps({"results": results}), file=out, flush=True)
+if not results:
+    raise AssertionError("no successful variant")
