@@ -48,7 +48,10 @@ def run_variant(chunked: bool):
                 x3, None, scale=0.5, causal=True,
                 interpret=not ON_TPU).astype(x3.dtype)
 
-        return timed_steps(step, x, iters=iters, floor_s=floor_s)
+        # donate=False: x is shared by both variants (a donated buffer
+        # would be deleted after the first)
+        return timed_steps(step, x, iters=iters, floor_s=floor_s,
+                           donate=False)
     finally:
         sk._softmax_fwd_causal_chunked = orig
 
