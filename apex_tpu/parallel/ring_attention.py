@@ -99,9 +99,12 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
 
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
-        # rotate K/V one hop around the ring
-        k_cur = _rotate(k_cur, axis_name, perm, transport)
-        v_cur = _rotate(v_cur, axis_name, perm, transport)
+        # the hop for the NEXT step is dataflow-independent of this step's
+        # flash compute, so XLA's latency-hiding scheduler overlaps the
+        # collective with the matmuls (a head-of-body rotate would
+        # serialize comm then compute)
+        k_nxt = _rotate(k_cur, axis_name, perm, transport)
+        v_nxt = _rotate(v_cur, axis_name, perm, transport)
         # after `step+1` hops I hold the shard of device (my - step - 1) mod n
         src = (my - step - 1) % n
         o_i, lse_i = flash_attention_fwd(q, k_cur, v_cur, scale=s,
@@ -112,11 +115,14 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
             allowed = src < my
             lse_i = jnp.where(allowed, lse_i, _NEG)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_i.astype(_f32), lse_i)
-        return (o_acc, lse_acc, k_cur, v_cur), None
+        return (o_acc, lse_acc, k_nxt, v_nxt), None
 
     if n > 1:
+        # first hop issued here, overlapping the diagonal block's compute
+        k1 = _rotate(k, axis_name, perm, transport)
+        v1 = _rotate(v, axis_name, perm, transport)
         (o, lse, _, _), _ = jax.lax.scan(
-            body, (o, lse, k, v), jnp.arange(n - 1))
+            body, (o, lse, k1, v1), jnp.arange(n - 1))
     return o.astype(q.dtype), lse
 
 
@@ -165,12 +171,12 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
     dv_cur = dv_cur.astype(_f32)
 
     def body(carry, step):
+        # carry holds the shard PRESENT on this device and its aligned
+        # gradient accumulator; rotations sit at the TAIL of the body so
+        # the k/v hop (independent of this step's compute) overlaps the
+        # backward matmuls. The dk/dv hop necessarily follows the add —
+        # that half of the comm is the ring-backward dependency chain.
         dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
-        # rotate the shard AND its gradient accumulators together
-        k_cur = _rotate(k_cur, axis_name, perm, transport)
-        v_cur = _rotate(v_cur, axis_name, perm, transport)
-        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
-        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
         src = (my - step - 1) % n
         dq_j, dk_j, dv_j, _ = flash_attention_bwd(
             q, k_cur, v_cur, o, lse, do, scale=s, causal=False,
@@ -185,14 +191,22 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
         dq_acc = dq_acc + dq_j.astype(_f32)
         dk_cur = dk_cur + dk_j.astype(_f32)
         dv_cur = dv_cur + dv_j.astype(_f32)
-        return (dq_acc, k_cur, v_cur, dk_cur, dv_cur), None
+        k_nxt = _rotate(k_cur, axis_name, perm, transport)
+        v_nxt = _rotate(v_cur, axis_name, perm, transport)
+        dk_nxt = _rotate(dk_cur, axis_name, perm, transport)
+        dv_nxt = _rotate(dv_cur, axis_name, perm, transport)
+        return (dq_acc, k_nxt, v_nxt, dk_nxt, dv_nxt), None
 
     if n > 1:
+        # pre-rotate once (overlapping the diagonal backward above); the
+        # body then rotates at its tail, so after n-1 iterations the
+        # accumulators have made n hops total = identity (home again)
+        k1 = _rotate(k, axis_name, perm, transport)
+        v1 = _rotate(v, axis_name, perm, transport)
+        dk1 = _rotate(dk_cur, axis_name, perm, transport)
+        dv1 = _rotate(dv_cur, axis_name, perm, transport)
         (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
-            body, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(n - 1))
-        # one final hop brings dK/dV home (n rotations total = identity)
-        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
-        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
+            body, (dq_acc, k1, v1, dk1, dv1), jnp.arange(n - 1))
     return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 
@@ -273,17 +287,21 @@ def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k,
 
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
-        k_cur = _rotate(k_cur, axis_name, perm, transport)
-        v_cur = _rotate(v_cur, axis_name, perm, transport)
+        # tail rotation: the next hop is independent of this step's flash
+        # compute, so the scheduler overlaps comm with the matmuls
+        k_nxt = _rotate(k_cur, axis_name, perm, transport)
+        v_nxt = _rotate(v_cur, axis_name, perm, transport)
         src = (my - step - 1) % n
         o_i, lse_i = jax.lax.cond(src < my, step_earlier, step_later,
                                   k_cur, v_cur)
         o_acc, lse_acc = _merge(o_acc, lse_acc, o_i, lse_i)
-        return (o_acc, lse_acc, k_cur, v_cur), None
+        return (o_acc, lse_acc, k_nxt, v_nxt), None
 
     if n > 1:
+        k1 = _rotate(k, axis_name, perm, transport)
+        v1 = _rotate(v, axis_name, perm, transport)
         (o, lse, _, _), _ = jax.lax.scan(
-            body, (o, lse, k, v), jnp.arange(n - 1))
+            body, (o, lse, k1, v1), jnp.arange(n - 1))
     return o.astype(q.dtype), lse
 
 
@@ -350,22 +368,27 @@ def _zz_vjp_bwd(axis_name, scale, block_q, block_k, transport, res, do):
         return dq_j, dk_j.astype(_f32), dv_j.astype(_f32)
 
     def body(carry, step):
+        # tail rotations (see _ring_vjp_bwd): the k/v hop overlaps this
+        # step's backward matmuls; the dk/dv hop follows the add
         dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
-        k_cur = _rotate(k_cur, axis_name, perm, transport)
-        v_cur = _rotate(v_cur, axis_name, perm, transport)
-        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
-        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
         src = (my - step - 1) % n
         dq_j, dk_j, dv_j = jax.lax.cond(src < my, bwd_earlier, bwd_later,
                                         k_cur, v_cur)
-        return (dq_acc + dq_j, k_cur, v_cur, dk_cur + dk_j,
-                dv_cur + dv_j), None
+        dk_cur = dk_cur + dk_j
+        dv_cur = dv_cur + dv_j
+        k_nxt = _rotate(k_cur, axis_name, perm, transport)
+        v_nxt = _rotate(v_cur, axis_name, perm, transport)
+        dk_nxt = _rotate(dk_cur, axis_name, perm, transport)
+        dv_nxt = _rotate(dv_cur, axis_name, perm, transport)
+        return (dq_acc + dq_j, k_nxt, v_nxt, dk_nxt, dv_nxt), None
 
     if n > 1:
+        k1 = _rotate(k, axis_name, perm, transport)
+        v1 = _rotate(v, axis_name, perm, transport)
+        dk1 = _rotate(dk_cur, axis_name, perm, transport)
+        dv1 = _rotate(dv_cur, axis_name, perm, transport)
         (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
-            body, (dq_acc, k, v, dk_cur, dv_cur), jnp.arange(n - 1))
-        dk_cur = _rotate(dk_cur, axis_name, perm, transport)
-        dv_cur = _rotate(dv_cur, axis_name, perm, transport)
+            body, (dq_acc, k1, v1, dk1, dv1), jnp.arange(n - 1))
     return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 
