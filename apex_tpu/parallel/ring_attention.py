@@ -8,7 +8,7 @@ primitives idiomatically: the Pallas flash kernel for the local block and
 the cross-device pass — K/V shards rotate around the ICI ring while each
 device's Q stays resident, with online log-sum-exp merging of partial results.
 
-Memory: O(local_seq · d) per device; comm: (n-1) ppermutes of the local K/V
+Memory: O(local_seq · d) per device; comm: n-1 K/V hops (+ n dK/dV hops in the backward) of the local
 shard per layer, riding ICI neighbor links (never DCN within a slice).
 
 Two sharding layouts:
@@ -97,6 +97,18 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    def compute_step(o_acc, lse_acc, k_cur, v_cur, step):
+        # at scan index `step` the carry holds the shard of device
+        # (my - step - 1) mod n (it has made step+1 hops)
+        src = (my - step - 1) % n
+        o_i, lse_i = flash_attention_fwd(q, k_cur, v_cur, scale=s,
+                                         causal=False, block_q=block_q,
+                                         block_k=block_k)
+        if causal:
+            # mask whole contribution when the source shard is in my future
+            lse_i = jnp.where(src < my, lse_i, _NEG)
+        return _merge(o_acc, lse_acc, o_i.astype(_f32), lse_i)
+
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
         # the hop for the NEXT step is dataflow-independent of this step's
@@ -105,24 +117,19 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
         # serialize comm then compute)
         k_nxt = _rotate(k_cur, axis_name, perm, transport)
         v_nxt = _rotate(v_cur, axis_name, perm, transport)
-        # after `step+1` hops I hold the shard of device (my - step - 1) mod n
-        src = (my - step - 1) % n
-        o_i, lse_i = flash_attention_fwd(q, k_cur, v_cur, scale=s,
-                                         causal=False, block_q=block_q,
-                                         block_k=block_k)
-        if causal:
-            # mask whole contribution when the source shard is in my future
-            allowed = src < my
-            lse_i = jnp.where(allowed, lse_i, _NEG)
-        o_acc, lse_acc = _merge(o_acc, lse_acc, o_i.astype(_f32), lse_i)
+        o_acc, lse_acc = compute_step(o_acc, lse_acc, k_cur, v_cur, step)
         return (o_acc, lse_acc, k_nxt, v_nxt), None
 
     if n > 1:
-        # first hop issued here, overlapping the diagonal block's compute
+        # first hop issued here, overlapping the diagonal block's compute;
+        # the LAST step is peeled out of the scan so no wasted (n-th) hop
+        # is ever issued — exactly n-1 K/V rotations total
         k1 = _rotate(k, axis_name, perm, transport)
         v1 = _rotate(v, axis_name, perm, transport)
-        (o, lse, _, _), _ = jax.lax.scan(
-            body, (o, lse, k1, v1), jnp.arange(n - 1))
+        if n > 2:
+            (o, lse, k1, v1), _ = jax.lax.scan(
+                body, (o, lse, k1, v1), jnp.arange(n - 2))
+        o, lse = compute_step(o, lse, k1, v1, n - 2)
     return o.astype(q.dtype), lse
 
 
@@ -170,13 +177,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
     dk_cur = dk_cur.astype(_f32)
     dv_cur = dv_cur.astype(_f32)
 
-    def body(carry, step):
-        # carry holds the shard PRESENT on this device and its aligned
-        # gradient accumulator; rotations sit at the TAIL of the body so
-        # the k/v hop (independent of this step's compute) overlaps the
-        # backward matmuls. The dk/dv hop necessarily follows the add —
-        # that half of the comm is the ring-backward dependency chain.
-        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+    def compute_step(k_cur, v_cur, step):
         src = (my - step - 1) % n
         dq_j, dk_j, dv_j, _ = flash_attention_bwd(
             q, k_cur, v_cur, o, lse, do, scale=s, causal=False,
@@ -188,9 +189,19 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
             dq_j = jnp.where(allowed, dq_j.astype(_f32), 0.0)
             dk_j = jnp.where(allowed, dk_j.astype(_f32), 0.0)
             dv_j = jnp.where(allowed, dv_j.astype(_f32), 0.0)
-        dq_acc = dq_acc + dq_j.astype(_f32)
-        dk_cur = dk_cur + dk_j.astype(_f32)
-        dv_cur = dv_cur + dv_j.astype(_f32)
+        return dq_j.astype(_f32), dk_j.astype(_f32), dv_j.astype(_f32)
+
+    def body(carry, step):
+        # carry holds the shard PRESENT on this device and its aligned
+        # gradient accumulator; rotations sit at the TAIL of the body so
+        # the k/v hop (independent of this step's compute) overlaps the
+        # backward matmuls. The dk/dv hop necessarily follows the add —
+        # that half of the comm is the ring-backward dependency chain.
+        dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
+        dq_j, dk_j, dv_j = compute_step(k_cur, v_cur, step)
+        dq_acc = dq_acc + dq_j
+        dk_cur = dk_cur + dk_j
+        dv_cur = dv_cur + dv_j
         k_nxt = _rotate(k_cur, axis_name, perm, transport)
         v_nxt = _rotate(v_cur, axis_name, perm, transport)
         dk_nxt = _rotate(dk_cur, axis_name, perm, transport)
@@ -199,14 +210,19 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
 
     if n > 1:
         # pre-rotate once (overlapping the diagonal backward above); the
-        # body then rotates at its tail, so after n-1 iterations the
-        # accumulators have made n hops total = identity (home again)
+        # last step is peeled: its k/v need no further hop (n-1 K/V hops
+        # total) while dk/dv take their final homecoming hop (n total)
         k1 = _rotate(k, axis_name, perm, transport)
         v1 = _rotate(v, axis_name, perm, transport)
         dk1 = _rotate(dk_cur, axis_name, perm, transport)
         dv1 = _rotate(dv_cur, axis_name, perm, transport)
-        (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
-            body, (dq_acc, k1, v1, dk1, dv1), jnp.arange(n - 1))
+        if n > 2:
+            (dq_acc, k1, v1, dk1, dv1), _ = jax.lax.scan(
+                body, (dq_acc, k1, v1, dk1, dv1), jnp.arange(n - 2))
+        dq_j, dk_j, dv_j = compute_step(k1, v1, n - 2)
+        dq_acc = dq_acc + dq_j
+        dk_cur = _rotate(dk1 + dk_j, axis_name, perm, transport)
+        dv_cur = _rotate(dv1 + dv_j, axis_name, perm, transport)
     return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 
@@ -285,23 +301,29 @@ def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k,
                                 axis=2)
         return o_i, lse_i
 
+    def compute_step(o_acc, lse_acc, k_cur, v_cur, step):
+        src = (my - step - 1) % n
+        o_i, lse_i = jax.lax.cond(src < my, step_earlier, step_later,
+                                  k_cur, v_cur)
+        return _merge(o_acc, lse_acc, o_i, lse_i)
+
     def body(carry, step):
         o_acc, lse_acc, k_cur, v_cur = carry
         # tail rotation: the next hop is independent of this step's flash
         # compute, so the scheduler overlaps comm with the matmuls
         k_nxt = _rotate(k_cur, axis_name, perm, transport)
         v_nxt = _rotate(v_cur, axis_name, perm, transport)
-        src = (my - step - 1) % n
-        o_i, lse_i = jax.lax.cond(src < my, step_earlier, step_later,
-                                  k_cur, v_cur)
-        o_acc, lse_acc = _merge(o_acc, lse_acc, o_i, lse_i)
+        o_acc, lse_acc = compute_step(o_acc, lse_acc, k_cur, v_cur, step)
         return (o_acc, lse_acc, k_nxt, v_nxt), None
 
     if n > 1:
+        # last step peeled: exactly n-1 hops, none wasted
         k1 = _rotate(k, axis_name, perm, transport)
         v1 = _rotate(v, axis_name, perm, transport)
-        (o, lse, _, _), _ = jax.lax.scan(
-            body, (o, lse, k1, v1), jnp.arange(n - 1))
+        if n > 2:
+            (o, lse, k1, v1), _ = jax.lax.scan(
+                body, (o, lse, k1, v1), jnp.arange(n - 2))
+        o, lse = compute_step(o, lse, k1, v1, n - 2)
     return o.astype(q.dtype), lse
 
 
@@ -367,13 +389,15 @@ def _zz_vjp_bwd(axis_name, scale, block_q, block_k, transport, res, do):
                                 dq_hi.astype(_f32)], axis=2)
         return dq_j, dk_j.astype(_f32), dv_j.astype(_f32)
 
+    def compute_step(k_cur, v_cur, step):
+        src = (my - step - 1) % n
+        return jax.lax.cond(src < my, bwd_earlier, bwd_later, k_cur, v_cur)
+
     def body(carry, step):
         # tail rotations (see _ring_vjp_bwd): the k/v hop overlaps this
         # step's backward matmuls; the dk/dv hop follows the add
         dq_acc, k_cur, v_cur, dk_cur, dv_cur = carry
-        src = (my - step - 1) % n
-        dq_j, dk_j, dv_j = jax.lax.cond(src < my, bwd_earlier, bwd_later,
-                                        k_cur, v_cur)
+        dq_j, dk_j, dv_j = compute_step(k_cur, v_cur, step)
         dk_cur = dk_cur + dk_j
         dv_cur = dv_cur + dv_j
         k_nxt = _rotate(k_cur, axis_name, perm, transport)
@@ -383,12 +407,18 @@ def _zz_vjp_bwd(axis_name, scale, block_q, block_k, transport, res, do):
         return (dq_acc + dq_j, k_nxt, v_nxt, dk_nxt, dv_nxt), None
 
     if n > 1:
+        # last step peeled: k/v make n-1 hops, dk/dv their homecoming n-th
         k1 = _rotate(k, axis_name, perm, transport)
         v1 = _rotate(v, axis_name, perm, transport)
         dk1 = _rotate(dk_cur, axis_name, perm, transport)
         dv1 = _rotate(dv_cur, axis_name, perm, transport)
-        (dq_acc, _, _, dk_cur, dv_cur), _ = jax.lax.scan(
-            body, (dq_acc, k1, v1, dk1, dv1), jnp.arange(n - 1))
+        if n > 2:
+            (dq_acc, k1, v1, dk1, dv1), _ = jax.lax.scan(
+                body, (dq_acc, k1, v1, dk1, dv1), jnp.arange(n - 2))
+        dq_j, dk_j, dv_j = compute_step(k1, v1, n - 2)
+        dq_acc = dq_acc + dq_j
+        dk_cur = _rotate(dk1 + dk_j, axis_name, perm, transport)
+        dv_cur = _rotate(dv1 + dv_j, axis_name, perm, transport)
     return (dq_acc.astype(q.dtype), dk_cur.astype(k.dtype),
             dv_cur.astype(v.dtype))
 
