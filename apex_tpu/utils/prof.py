@@ -67,3 +67,45 @@ class StepTimer:
     @property
     def avg(self) -> float:
         return self.total / max(self.count, 1)
+
+
+# chip peaks for roofline reporting (bf16 TFLOPs, HBM GB/s)
+CHIP_PEAKS = {
+    "v5e": {"hbm_gbps": 819.0, "tflops": 197.0},
+    "v6e": {"hbm_gbps": 1640.0, "tflops": 918.0},
+    "v5p": {"hbm_gbps": 2765.0, "tflops": 459.0},
+}
+
+
+def roofline(fn, *args, chip: str | None = None,
+             measured_ms: float | None = None) -> dict:
+    """Compile ``fn(*args)`` and report XLA's own cost model against the
+    chip roofline — the first-class version of the analysis the reference
+    does ad hoc with nvprof (SURVEY §5 tracing row).
+
+    Returns ``{flops, bytes, t_mxu_ms, t_hbm_ms, bound, ideal_ms}`` plus,
+    when ``measured_ms`` is given, ``achieved_frac`` (ideal/measured —
+    how close the step runs to its own roofline) and the per-resource
+    fractions. ``chip`` defaults to ``PALLAS_AXON_TPU_GEN`` (v5e).
+    """
+    import os
+
+    chip = chip or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    peaks = CHIP_PEAKS.get(chip, CHIP_PEAKS["v5e"])
+    compiled = jax.jit(fn).lower(*args).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    flops = float(ca.get("flops", 0.0))
+    nbytes = float(ca.get("bytes accessed", 0.0))
+    t_mxu = flops / (peaks["tflops"] * 1e12) * 1e3
+    t_hbm = nbytes / (peaks["hbm_gbps"] * 1e9) * 1e3
+    out = {"chip": chip, "flops": flops, "bytes": nbytes,
+           "t_mxu_ms": t_mxu, "t_hbm_ms": t_hbm,
+           "bound": "mxu" if t_mxu > t_hbm else "hbm",
+           "ideal_ms": max(t_mxu, t_hbm)}
+    if measured_ms is not None and measured_ms > 0:
+        out["measured_ms"] = measured_ms
+        out["achieved_frac"] = out["ideal_ms"] / measured_ms
+        out["mxu_frac"] = t_mxu / measured_ms
+        out["hbm_frac"] = t_hbm / measured_ms
+    return out

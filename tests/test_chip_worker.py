@@ -66,3 +66,16 @@ class TestStatus:
         assert st["pid"] == os.getpid()
         assert "t" in st
         assert not os.path.exists(chip_worker.STATUS + ".tmp")
+
+
+class TestRooflineAPI:
+    def test_matmul_cost_model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from apex_tpu.utils.prof import roofline
+        r = roofline(lambda a, b: a @ b, jnp.ones((256, 256)),
+                     jnp.ones((256, 256)), chip="v5e", measured_ms=1.0)
+        assert r["flops"] >= 2 * 256 ** 3 * 0.9
+        assert r["bound"] in ("mxu", "hbm")
+        assert 0 < r["achieved_frac"] < 1

@@ -86,19 +86,12 @@ ms = timed_steps(train_step, (params, m0, v0, bstats), iters=iters,
 result["measured_step_ms"] = round(ms, 2)
 result["imgs_per_sec"] = round(batch / (ms / 1e3), 1)
 
-peak_flops = chip["tflops"] * 1e12
-peak_bw = chip["hbm_gbps"] * 1e9
-t_flops_ms = flops / peak_flops * 1e3
-t_bytes_ms = bytes_acc / peak_bw * 1e3
-roofline_ms = max(t_flops_ms, t_bytes_ms)
-result["roofline"] = {
-    "t_mxu_ms": round(t_flops_ms, 2), "t_hbm_ms": round(t_bytes_ms, 2),
-    "bound": "mxu" if t_flops_ms > t_bytes_ms else "hbm",
-    "ideal_ms": round(roofline_ms, 2),
-    "achieved_frac": round(roofline_ms / ms, 3) if ms > 0 else 0.0,
-    "mxu_frac": round(t_flops_ms / ms, 3),
-    "hbm_frac": round(t_bytes_ms / ms, 3),
-}
+from apex_tpu.utils.prof import roofline  # noqa: E402
+
+rl = roofline(lambda st, x, y: train_step(0, st, x, y),
+              (params, m0, v0, bstats), x, y, measured_ms=ms)
+result["roofline"] = {k: (round(v, 3) if isinstance(v, float) else v)
+                      for k, v in rl.items()}
 
 # --- best-effort device trace -------------------------------------------
 trace_dir = os.path.join(ROOT, "traces", "resnet50")
