@@ -1,0 +1,92 @@
+"""Chip job: set flash-attention block defaults from the q030 sweep.
+
+Reads the best (bq, bk) from tools/tune_flash.out, patches
+DEFAULT_BLOCK_Q/K in the kernel source, COMMITS the change, then
+re-measures through the public frontend (worker purges modules between
+jobs, so the fresh import picks up the edit) and records the
+verification in FLASH_DEFAULTS_APPLIED.json. Runs after q030 by queue
+order; fails (and retries later) if the sweep output is absent.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+if jax.default_backend() != "tpu" and \
+        os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError("backend is not tpu")
+
+sweep_path = os.path.join(ROOT, "tools", "tune_flash.out")
+best = None
+with open(sweep_path) as f:
+    for line in f:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec.get("best"), dict):
+                best = rec["best"]
+if best is None or "bq" not in best:
+    raise AssertionError("no best config in tune_flash.out yet")
+bq, bk = int(best["bq"]), int(best["bk"])
+
+kpath = os.path.join(ROOT, "apex_tpu", "ops", "pallas",
+                     "flash_attention.py")
+src = open(kpath).read()
+cur_q = int(re.search(r"DEFAULT_BLOCK_Q = (\d+)", src).group(1))
+cur_k = int(re.search(r"DEFAULT_BLOCK_K = (\d+)", src).group(1))
+changed = (cur_q, cur_k) != (bq, bk)
+if changed:
+    src = re.sub(r"DEFAULT_BLOCK_Q = \d+", f"DEFAULT_BLOCK_Q = {bq}", src)
+    src = re.sub(r"DEFAULT_BLOCK_K = \d+", f"DEFAULT_BLOCK_K = {bk}", src)
+    open(kpath, "w").write(src)
+    subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
+    subprocess.run(
+        ["git", "commit", "-q", "-m",
+         f"Set flash block defaults from on-chip sweep: bq={bq} bk={bk} "
+         f"(was {cur_q}/{cur_k}; fwd {best.get('fwd_tflops')} TFLOPs, "
+         f"mxu {best.get('fwd_mxu')})"],
+        cwd=ROOT, check=True)
+
+# verify: re-measure through the frontend at the (possibly new) defaults
+import importlib  # noqa: E402
+
+for m in [m for m in sys.modules if m.startswith("apex_tpu")]:
+    del sys.modules[m]
+from apex_tpu.ops.pallas import flash_attention as fa  # noqa: E402
+from apex_tpu.utils.benchtime import (measure_fetch_floor,  # noqa: E402
+                                      timed_steps)
+
+ON_TPU = jax.default_backend() == "tpu"
+b, h, s, d = (4, 16, 2048, 64) if ON_TPU else (1, 2, 256, 64)
+ks = jax.random.split(jax.random.PRNGKey(0), 3)
+q, k, v = (jax.random.normal(k_, (b, h, s, d), jnp.bfloat16) * 0.2
+           for k_ in ks)
+ms = timed_steps(
+    lambda i, q, k, v: fa.flash_attention(q, k, v, True).astype(q.dtype),
+    q, iters=20 if ON_TPU else 2, consts=(k, v),
+    floor_s=measure_fetch_floor(), donate=False)
+fl = 2 * 2 * b * h * s * s * d / 2
+rec = {"applied": {"bq": fa.DEFAULT_BLOCK_Q, "bk": fa.DEFAULT_BLOCK_K},
+       "was": {"bq": cur_q, "bk": cur_k}, "changed": changed,
+       "sweep_best": best, "verify_fwd_ms": round(ms, 3),
+       "verify_fwd_tflops": round(fl / (ms / 1e3) / 1e12, 1),
+       "captured": time.strftime("%Y-%m-%dT%H:%M:%S")}
+import bench  # noqa: E402
+
+bench.atomic_write_json(os.path.join(ROOT, "FLASH_DEFAULTS_APPLIED.json"),
+                        rec)
+print(json.dumps(rec))

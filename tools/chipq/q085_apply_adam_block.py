@@ -1,0 +1,69 @@
+"""Chip job: set the fused-Adam streaming block from the q060 sweep.
+
+Reads the best block_rows from tools/tune_adam.out, patches
+DEFAULT_BLOCK_ROWS in the kernel, commits, and records the application.
+Only commits when the winner beats the current default's measured frac by
+>2% (block choice is a plateau; don't churn the source for noise).
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+
+if jax.default_backend() != "tpu" and \
+        os.environ.get("CHIPQ_ALLOW_CPU") != "1":
+    raise AssertionError("backend is not tpu")
+
+best = None
+rows = {}
+with open(os.path.join(ROOT, "tools", "tune_adam.out")) as f:
+    for line in f:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec.get("best"), dict):
+                best = rec["best"]
+            elif "block_rows" in rec and "hbm_frac" in rec:
+                rows[rec["block_rows"]] = rec["hbm_frac"]
+if best is None:
+    raise AssertionError("no best config in tune_adam.out yet")
+
+kpath = os.path.join(ROOT, "apex_tpu", "ops", "pallas",
+                     "fused_adam_kernel.py")
+src = open(kpath).read()
+cur = int(re.search(r"DEFAULT_BLOCK_ROWS = (\d+)", src).group(1))
+cur_frac = rows.get(cur, 0.0)
+apply = (int(best["block_rows"]) != cur
+         and best["hbm_frac"] > cur_frac * 1.02)
+if apply:
+    src = re.sub(r"DEFAULT_BLOCK_ROWS = \d+",
+                 f"DEFAULT_BLOCK_ROWS = {int(best['block_rows'])}", src)
+    open(kpath, "w").write(src)
+    subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
+    subprocess.run(
+        ["git", "commit", "-q", "-m",
+         f"Set fused-Adam streaming block from on-chip sweep: "
+         f"{best['block_rows']} rows ({best['hbm_frac']} HBM frac vs "
+         f"{cur_frac} at {cur})"], cwd=ROOT, check=True)
+
+import bench  # noqa: E402
+
+bench.atomic_write_json(
+    os.path.join(ROOT, "ADAM_BLOCK_APPLIED.json"),
+    {"applied": apply, "best": best, "previous": cur,
+     "previous_frac": cur_frac,
+     "captured": time.strftime("%Y-%m-%dT%H:%M:%S")})
+print(json.dumps({"applied": apply, "best": best}))
