@@ -7,9 +7,12 @@ without initializing any backend.
 import importlib
 import json
 import os
+import subprocess
 import sys
 
 import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
 import chip_worker  # noqa: E402
@@ -79,3 +82,38 @@ class TestRooflineAPI:
         assert r["flops"] >= 2 * 256 ** 3 * 0.9
         assert r["bound"] in ("mxu", "hbm")
         assert 0 < r["achieved_frac"] < 1
+
+
+class TestWorkerEndToEnd:
+    def test_runs_queue_and_exits(self, tmp_path):
+        """Drive the real worker main() in a subprocess against a
+        throwaway queue: one passing job, one failing job (retried to the
+        cap), STOP honored, markers and status written."""
+        q = tmp_path / "q"
+        (q / "done").mkdir(parents=True)
+        (q / "failed").mkdir()
+        (q / "q010_ok.py").write_text(
+            "open(%r, 'w').write('ran')\n" % str(tmp_path / "touch.txt"))
+        (q / "q020_bad.py").write_text("raise RuntimeError('boom')\n")
+        # no STOP file: CHIPQ_IDLE_EXIT_S=1 exits once the queue drains
+        # (a pre-created STOP would exit before any job ran)
+
+        env = dict(os.environ)
+        kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p]
+        env["PYTHONPATH"] = os.pathsep.join(kept + [ROOT])
+        env["JAX_PLATFORMS"] = "cpu"
+        env["CHIPQ_DIR"] = str(q)
+        env["CHIPQ_ALLOW_CPU"] = "1"
+        env["CHIPQ_IDLE_EXIT_S"] = "1"
+        r = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "chip_worker.py")],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        assert (tmp_path / "touch.txt").read_text() == "ran"
+        assert (q / "done" / "q010_ok.py.json").exists()
+        fails = sorted(os.listdir(q / "failed"))
+        assert fails == ["q020_bad.py.1.json", "q020_bad.py.2.json",
+                         "q020_bad.py.3.json"], fails
+        st = json.load(open(q / "status.json"))
+        assert st["phase"] == "exited"

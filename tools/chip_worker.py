@@ -35,7 +35,9 @@ import time
 import traceback
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-QDIR = os.path.join(ROOT, "tools", "chipq")
+# CHIPQ_DIR override lets tests drive the worker end-to-end against a
+# throwaway queue without touching the real one
+QDIR = os.environ.get("CHIPQ_DIR", os.path.join(ROOT, "tools", "chipq"))
 DONE = os.path.join(QDIR, "done")
 FAILED = os.path.join(QDIR, "failed")
 STATUS = os.path.join(QDIR, "status.json")
