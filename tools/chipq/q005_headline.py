@@ -33,7 +33,7 @@ floor_s = measure_fetch_floor()
 entry = bench.bench_fused_adam(jax, jnp, backend == "tpu", chip, floor_s)
 suite = {"backend": backend, "chip": gen, "complete": False,
          "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
-         "note": "headline-only early capture (q015); q020 overwrites",
+         "note": "headline-only early capture (q005); q020 overwrites",
          "fused_adam_1b": entry}
 out = os.path.join(ROOT, "BENCH_TPU_CACHE.json" if backend == "tpu"
                    else "BENCH_SMOKE_HEADLINE.json")
