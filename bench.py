@@ -542,6 +542,10 @@ def _emit(suite, cached: bool) -> None:
     # the backend is part of the record: a CPU-smoke capture must be
     # unmistakable AND fail the run (rounds 1-2 shipped silent cpu rc=0)
     line["backend"] = backend
+    if not suite.get("complete"):
+        # a partial capture (e.g. headline-only q005) must be unmistakable
+        # in the one-line record, not just in the untracked cache file
+        line["complete"] = False
     if cached:
         line["cached"] = True
         line["captured"] = suite.get("captured")
@@ -635,11 +639,19 @@ def main():
             if not _worker_alive():  # died/idle-exited: stop burning the
                 break                # driver window waiting on nothing
             time.sleep(20)
-        suite = _load_cache() or _load_cache(require_complete=False)
-        if suite is not None:  # accept even a partial capture at deadline
+        suite = _load_cache()
+        if suite is not None:  # complete capture: promote to the suite file
             atomic_write_json(os.path.join(_HERE, "BENCH_SUITE.json"),
                               suite)
             _emit(suite, cached=True)
+        partial = _load_cache(require_complete=False)
+        if partial is not None:
+            # partial capture at deadline: emit it (a real-TPU headline beats
+            # a CPU smoke) but do NOT overwrite the tracked BENCH_SUITE.json
+            # — that file's contract is "best-known COMPLETE real-TPU
+            # capture" and a committed full suite must survive a
+            # headline-only q005 run (ADVICE r4)
+            _emit(partial, cached=True)
 
     if worker_was_alive and _worker_alive():
         # the worker still holds the chip and never produced a usable

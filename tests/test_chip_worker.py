@@ -48,6 +48,43 @@ class TestFailCount:
         assert chip_worker._fail_count("q010_x.py") == 0
 
 
+class TestRetryBackoff:
+    """ADVICE r4: a failed job must cool down between retries so a
+    transient relay outage can't burn all 3 attempts within seconds."""
+
+    def test_fresh_job_runnable(self, qdirs):
+        assert chip_worker.job_runnable("q010_x.py", 600)
+
+    def test_done_job_not_runnable(self, qdirs):
+        _, done, _ = qdirs
+        (done / "q010_x.py.json").write_text("{}")
+        assert not chip_worker.job_runnable("q010_x.py", 0)
+
+    def test_recent_failure_defers(self, qdirs):
+        _, _, failed = qdirs
+        (failed / "q010_x.py.1.json").write_text("{}")  # mtime = now
+        assert not chip_worker.job_runnable("q010_x.py", 600)
+        # zero backoff ⇒ immediately retryable (legacy behavior)
+        assert chip_worker.job_runnable("q010_x.py", 0)
+
+    def test_cooled_failure_retries(self, qdirs):
+        _, _, failed = qdirs
+        m = failed / "q010_x.py.1.json"
+        m.write_text("{}")
+        old = os.path.getmtime(m) - 1000
+        os.utime(m, (old, old))
+        assert chip_worker.job_runnable("q010_x.py", 600)
+
+    def test_fail_cap_parks_job(self, qdirs):
+        _, _, failed = qdirs
+        for i in (1, 2, 3):
+            m = failed / f"q010_x.py.{i}.json"
+            m.write_text("{}")
+            old = os.path.getmtime(m) - 10000
+            os.utime(m, (old, old))
+        assert not chip_worker.job_runnable("q010_x.py", 0)
+
+
 class TestPurge:
     def test_purges_repo_modules_not_thirdparty(self):
         import bench  # noqa: F401  (repo module; should be purged)
@@ -106,6 +143,10 @@ class TestWorkerEndToEnd:
         env["CHIPQ_DIR"] = str(q)
         env["CHIPQ_ALLOW_CPU"] = "1"
         env["CHIPQ_IDLE_EXIT_S"] = "1"
+        # retry backoff is covered by TestRetryBackoff; here let the
+        # failing job burn its 3 attempts immediately so the end-to-end
+        # run stays fast
+        env["CHIPQ_RETRY_BACKOFF_S"] = "0"
         r = subprocess.run(
             [sys.executable, os.path.join(ROOT, "tools", "chip_worker.py")],
             env=env, capture_output=True, text=True, timeout=300)

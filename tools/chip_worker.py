@@ -54,6 +54,30 @@ def _fail_count(job: str) -> int:
         return 0
 
 
+def _last_fail_age_s(job: str) -> float:
+    """Seconds since the newest failure marker for ``job`` (inf if none)."""
+    try:
+        ts = [os.path.getmtime(os.path.join(FAILED, f))
+              for f in os.listdir(FAILED) if f.startswith(job + ".")]
+    except FileNotFoundError:
+        return float("inf")
+    return time.time() - max(ts) if ts else float("inf")
+
+
+def job_runnable(job: str, retry_backoff_s: float) -> bool:
+    """done marker ⇒ finished OK; failed markers are retried up to 3 times
+    (a transient relay error must not permanently block a job, a
+    deterministic failure must not loop forever), with a backoff after each
+    failure so a transient outage can't burn all 3 attempts within seconds
+    (ADVICE r4) — later jobs run while a freshly-failed one cools down."""
+    if os.path.exists(os.path.join(DONE, job + ".json")):
+        return False
+    n = _fail_count(job)
+    if n >= 3:
+        return False
+    return n == 0 or _last_fail_age_s(job) >= retry_backoff_s
+
+
 def log(msg: str) -> None:
     print(f"[worker {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
@@ -133,22 +157,25 @@ def main() -> None:
         jobs = sorted(f for f in os.listdir(QDIR)
                       if f.startswith("q") and f.endswith(".py"))
 
-        def runnable(j):
-            # done marker ⇒ finished OK; failed markers are retried up to
-            # 3 times (a transient relay error must not permanently block a
-            # job, a deterministic failure must not loop forever)
-            if os.path.exists(os.path.join(DONE, j + ".json")):
-                return False
-            return _fail_count(j) < 3
-
-        pending = [j for j in jobs if runnable(j)]
+        retry_backoff_s = float(os.environ.get("CHIPQ_RETRY_BACKOFF_S",
+                                               "600"))
+        pending = [j for j in jobs if job_runnable(j, retry_backoff_s)]
         if not pending:
+            cooling = [j for j in jobs
+                       if not os.path.exists(os.path.join(DONE, j + ".json"))
+                       and 0 < _fail_count(j) < 3]
+            if cooling:  # deferred retries exist: don't start the idle clock
+                last_work = time.time()
             if time.time() - last_work > idle_exit_s:
                 log(f"queue idle for {idle_exit_s:.0f}s — exiting to "
                     "release the chip claim")
                 break
+            n_done = sum(
+                1 for j in jobs
+                if os.path.exists(os.path.join(DONE, j + ".json")))
             write_status(phase="idle", backend=backend,
-                         done=len(jobs), pending=0)
+                         done=n_done, pending=0,
+                         cooling=len(cooling))
             time.sleep(15)
             continue
         name = pending[0]

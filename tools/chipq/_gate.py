@@ -1,0 +1,60 @@
+"""Commit gate for self-applying chip jobs (VERDICT r4 item 8).
+
+q080/q085 patch kernel source and ``git commit`` autonomously. Before any
+such commit, run the fast flash/softmax/Adam parity subset of the unit
+suite (CPU, interpret mode) in a subprocess so a corrupt sweep artifact or
+a block combination that breaks a non-bench shape can never be committed.
+The gate result is recorded in the job's applied-defaults artifact.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# fast, targeted: the tests that exercise the exact kernels the
+# self-applying jobs patch (flash blocks, softmax, fused Adam)
+GATE_TESTS = [
+    "tests/test_flash_attention.py",
+    "tests/test_transformer_ops.py",   # megatron softmax family
+    "tests/test_fused_optimizers.py::TestFusedAdam",
+]
+
+
+def run_test_gate(tests: list[str] | None = None,
+                  timeout_s: float = 900.0) -> dict:
+    """Run the parity-test subset on CPU; return {ok, rc, wall_s, tail}.
+
+    Runs in a subprocess with the axon hook stripped (sanitized_cpu_env)
+    so the gate can never touch the TPU relay the calling worker holds.
+    """
+    sys.path.insert(0, ROOT)
+    from __graft_entry__ import sanitized_cpu_env
+
+    env = sanitized_cpu_env()  # CPU ⇒ kernels run in interpret mode
+    cmd = [sys.executable, "-m", "pytest", "-x", "-q",
+           *(tests or GATE_TESTS)]
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, cwd=ROOT, env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        rc, tail = proc.returncode, (proc.stdout + proc.stderr)[-2000:]
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        tail = f"gate timeout after {timeout_s}s: " + \
+            ((e.stdout or b"").decode("utf-8", "replace")[-1500:]
+             if isinstance(e.stdout, bytes) else str(e.stdout)[-1500:])
+    return {"ok": rc == 0, "rc": rc,
+            "wall_s": round(time.time() - t0, 1), "tail": tail,
+            "tests": tests or GATE_TESTS}
+
+
+def revert_file(path: str) -> None:
+    """Drop an uncommitted patch to ``path`` (gate failed)."""
+    subprocess.run(["git", "checkout", "--", path], cwd=ROOT, check=True)

@@ -81,8 +81,11 @@ result = {"backend": backend, "chip": gen if ON_TPU else "cpu",
 # --- measured step time --------------------------------------------------
 floor_s = measure_fetch_floor()
 iters = 10 if ON_TPU else 2
+# donate=False: the profiler-trace block below re-executes the step on
+# this same state tuple; donation would leave it deleted (ADVICE r4) and
+# ResNet-50 state (~300 MB fp32) comfortably fits HBM without aliasing
 ms = timed_steps(train_step, (params, m0, v0, bstats), iters=iters,
-                 consts=(x, y), floor_s=floor_s)
+                 consts=(x, y), floor_s=floor_s, donate=False)
 result["measured_step_ms"] = round(ms, 2)
 result["imgs_per_sec"] = round(batch / (ms / 1e3), 1)
 

@@ -19,6 +19,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
+_QDIR = os.path.dirname(os.path.abspath(__file__))
+if _QDIR not in sys.path:  # for the _gate commit-gate helper
+    sys.path.insert(0, _QDIR)
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -49,17 +52,34 @@ src = open(kpath).read()
 cur_q = int(re.search(r"DEFAULT_BLOCK_Q = (\d+)", src).group(1))
 cur_k = int(re.search(r"DEFAULT_BLOCK_K = (\d+)", src).group(1))
 changed = (cur_q, cur_k) != (bq, bk)
+gate = None
 if changed:
     src = re.sub(r"DEFAULT_BLOCK_Q = \d+", f"DEFAULT_BLOCK_Q = {bq}", src)
     src = re.sub(r"DEFAULT_BLOCK_K = \d+", f"DEFAULT_BLOCK_K = {bk}", src)
     open(kpath, "w").write(src)
-    subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
-    subprocess.run(
-        ["git", "commit", "-q", "-m",
-         f"Set flash block defaults from on-chip sweep: bq={bq} bk={bk} "
-         f"(was {cur_q}/{cur_k}; fwd {best.get('fwd_tflops')} TFLOPs, "
-         f"mxu {best.get('fwd_mxu')})"],
-        cwd=ROOT, check=True)
+    # commit gate (VERDICT r4 item 8): the fast parity subset must pass on
+    # the patched source before the autonomous commit; a failing gate
+    # reverts the patch instead of committing it
+    from _gate import revert_file, run_test_gate
+
+    gate = run_test_gate()
+    if gate["rc"] == -1:
+        # gate TIMEOUT is transient (loaded host), not a verdict on the
+        # patch: revert and raise so the worker's retry-with-backoff
+        # machinery re-runs this job instead of parking it as done
+        revert_file(kpath)
+        raise AssertionError(f"commit gate timed out: {gate['tail'][-300:]}")
+    if not gate["ok"]:
+        revert_file(kpath)
+        changed = False
+    else:
+        subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
+        subprocess.run(
+            ["git", "commit", "-q", "-m",
+             f"Set flash block defaults from on-chip sweep: bq={bq} bk={bk} "
+             f"(was {cur_q}/{cur_k}; fwd {best.get('fwd_tflops')} TFLOPs, "
+             f"mxu {best.get('fwd_mxu')}; parity gate passed)"],
+            cwd=ROOT, check=True)
 
 # verify: re-measure through the frontend at the (possibly new) defaults
 import importlib  # noqa: E402
@@ -82,6 +102,7 @@ ms = timed_steps(
 fl = 2 * 2 * b * h * s * s * d / 2
 rec = {"applied": {"bq": fa.DEFAULT_BLOCK_Q, "bk": fa.DEFAULT_BLOCK_K},
        "was": {"bq": cur_q, "bk": cur_k}, "changed": changed,
+       "test_gate": gate,
        "sweep_best": best, "verify_fwd_ms": round(ms, 3),
        "verify_fwd_tflops": round(fl / (ms / 1e3) / 1e12, 1),
        "captured": time.strftime("%Y-%m-%dT%H:%M:%S")}

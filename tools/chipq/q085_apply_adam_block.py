@@ -17,6 +17,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
+_QDIR = os.path.dirname(os.path.abspath(__file__))
+if _QDIR not in sys.path:  # for the _gate commit-gate helper
+    sys.path.insert(0, _QDIR)
 
 import jax  # noqa: E402
 
@@ -45,25 +48,46 @@ kpath = os.path.join(ROOT, "apex_tpu", "ops", "pallas",
                      "fused_adam_kernel.py")
 src = open(kpath).read()
 cur = int(re.search(r"DEFAULT_BLOCK_ROWS = (\d+)", src).group(1))
-cur_frac = rows.get(cur, 0.0)
-apply = (int(best["block_rows"]) != cur
+cur_frac = rows.get(cur)
+# incumbent row missing/errored ⇒ there is no comparison to justify a
+# source change; skip instead of letting cur_frac=0.0 pass the no-churn
+# gate trivially (ADVICE r4)
+apply = (cur_frac is not None
+         and int(best["block_rows"]) != cur
          and best["hbm_frac"] > cur_frac * 1.02)
+gate = None
 if apply:
     src = re.sub(r"DEFAULT_BLOCK_ROWS = \d+",
                  f"DEFAULT_BLOCK_ROWS = {int(best['block_rows'])}", src)
     open(kpath, "w").write(src)
-    subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
-    subprocess.run(
-        ["git", "commit", "-q", "-m",
-         f"Set fused-Adam streaming block from on-chip sweep: "
-         f"{best['block_rows']} rows ({best['hbm_frac']} HBM frac vs "
-         f"{cur_frac} at {cur})"], cwd=ROOT, check=True)
+    # commit gate (VERDICT r4 item 8): parity subset must pass on the
+    # patched source; failure reverts instead of committing
+    from _gate import revert_file, run_test_gate
+
+    gate = run_test_gate()
+    if gate["rc"] == -1:
+        # gate TIMEOUT is transient (loaded host), not a verdict on the
+        # patch: revert and raise so the worker's retry-with-backoff
+        # machinery re-runs this job instead of parking it as done
+        revert_file(kpath)
+        raise AssertionError(f"commit gate timed out: {gate['tail'][-300:]}")
+    if not gate["ok"]:
+        revert_file(kpath)
+        apply = False
+    else:
+        subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
+        subprocess.run(
+            ["git", "commit", "-q", "-m",
+             f"Set fused-Adam streaming block from on-chip sweep: "
+             f"{best['block_rows']} rows ({best['hbm_frac']} HBM frac vs "
+             f"{cur_frac} at {cur}; parity gate passed)"],
+            cwd=ROOT, check=True)
 
 import bench  # noqa: E402
 
 bench.atomic_write_json(
     os.path.join(ROOT, "ADAM_BLOCK_APPLIED.json"),
     {"applied": apply, "best": best, "previous": cur,
-     "previous_frac": cur_frac,
+     "previous_frac": cur_frac, "test_gate": gate,
      "captured": time.strftime("%Y-%m-%dT%H:%M:%S")})
 print(json.dumps({"applied": apply, "best": best}))
