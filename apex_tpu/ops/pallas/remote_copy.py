@@ -59,24 +59,41 @@ def peer_shift(x: jax.Array, axis_name: str, shift: int = 1,
     )(x)
 
 
+def _tile_rows(dtype) -> int:
+    """Minimum sublane (second-minor) tile for ``dtype`` — HBM memref
+    slices must be tile-aligned on this axis (Mosaic rejects e.g. a 2-row
+    f32 slice of an (8,128)-tiled ref; caught by tools/mosaic_aot.py)."""
+    return {1: 32, 2: 16}.get(jnp.dtype(dtype).itemsize, 8)
+
+
 def _halo_kernel(x_ref, lo_ref, hi_ref, slo, shi, rlo, rhi, *,
-                 axis_name, halo):
+                 axis_name, send_rows, full):
     """Send my low edge to the LEFT neighbor's ``hi`` buffer and my high
     edge to the RIGHT neighbor's ``lo`` buffer (periodic ring; the wrapper
-    zeroes wrap-around halos for non-periodic semantics)."""
+    zeroes wrap-around halos for non-periodic semantics).
+
+    ``send_rows`` is the halo rounded UP to the dtype's sublane tile: HBM
+    slices must be tile-aligned, so we over-send whole tiles and the
+    wrapper slices the true halo out of the landed buffer. ``full`` ships
+    the entire ref (no slice at all) when the shard is too small or not
+    tile-aligned."""
     my = jax.lax.axis_index(axis_name)
     n = jax.lax.axis_size(axis_name)
     left = jax.lax.rem(my - 1 + n, n)
     right = jax.lax.rem(my + 1, n)
-    # my first `halo` rows -> left neighbor's hi_ref
+    if full:
+        src_lo = src_hi = x_ref
+    else:
+        src_lo = x_ref.at[pl.ds(0, send_rows)]
+        src_hi = x_ref.at[pl.ds(x_ref.shape[0] - send_rows, send_rows)]
+    # my low-edge tiles -> left neighbor's hi_ref
     put_lo = pltpu.make_async_remote_copy(
-        src_ref=x_ref.at[pl.ds(0, halo)], dst_ref=hi_ref,
+        src_ref=src_lo, dst_ref=hi_ref,
         send_sem=slo, recv_sem=rhi,
         device_id=left, device_id_type=pltpu.DeviceIdType.LOGICAL)
-    # my last `halo` rows -> right neighbor's lo_ref
+    # my high-edge tiles -> right neighbor's lo_ref
     put_hi = pltpu.make_async_remote_copy(
-        src_ref=x_ref.at[pl.ds(x_ref.shape[0] - halo, halo)],
-        dst_ref=lo_ref, send_sem=shi, recv_sem=rlo,
+        src_ref=src_hi, dst_ref=lo_ref, send_sem=shi, recv_sem=rlo,
         device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
     put_lo.start()
     put_hi.start()
@@ -95,11 +112,20 @@ def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
     convention in ``parallel.halo``."""
     if interpret is None:
         interpret = interpret_default()
-    lo, hi = pl.pallas_call(
-        functools.partial(_halo_kernel, axis_name=axis_name, halo=halo),
+    rows = x.shape[0]
+    t = _tile_rows(x.dtype)
+    send_rows = -(-halo // t) * t  # halo rounded up to the sublane tile
+    # whole-ref transfer when the shard is too small for an aligned edge
+    # slice (also covers shards whose row count breaks the high-edge
+    # slice's tile alignment)
+    full = send_rows >= rows or rows % t != 0
+    buf_rows = rows if full else send_rows
+    lo_buf, hi_buf = pl.pallas_call(
+        functools.partial(_halo_kernel, axis_name=axis_name,
+                          send_rows=send_rows, full=full),
         out_shape=[
-            jax.ShapeDtypeStruct((halo,) + x.shape[1:], x.dtype),
-            jax.ShapeDtypeStruct((halo,) + x.shape[1:], x.dtype),
+            jax.ShapeDtypeStruct((buf_rows,) + x.shape[1:], x.dtype),
+            jax.ShapeDtypeStruct((buf_rows,) + x.shape[1:], x.dtype),
         ],
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=[pl.BlockSpec(memory_space=pl.ANY),
@@ -108,6 +134,10 @@ def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
                         pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
         interpret=interpret,
     )(x)
+    # the landed buffers carry whole tiles; the true halo is the left
+    # neighbor's LAST rows / right neighbor's FIRST rows
+    lo = jax.lax.slice_in_dim(lo_buf, buf_rows - halo, buf_rows, axis=0)
+    hi = jax.lax.slice_in_dim(hi_buf, 0, halo, axis=0)
     if not periodic:
         idx = jax.lax.axis_index(axis_name)
         n = jax.lax.axis_size(axis_name)

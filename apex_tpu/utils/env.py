@@ -5,6 +5,7 @@ Pallas TPU kernels run compiled on TPU and in interpreter mode everywhere else
 """
 
 import functools
+import os
 
 import jax
 
@@ -18,5 +19,13 @@ def platform_is_tpu() -> bool:
 
 
 def interpret_default() -> bool:
-    """Whether pallas_call should run in interpret mode (True off-TPU)."""
+    """Whether pallas_call should run in interpret mode (True off-TPU).
+
+    ``APEX_TPU_FORCE_COMPILED=1`` forces the compiled (Mosaic) lowering even
+    when the default backend is CPU — used by tools/mosaic_aot.py to AOT-
+    compile the kernel zoo against a deviceless TPU topology
+    (jax.experimental.topologies), where the host backend is CPU but the
+    jit target is a compile-only v5e client."""
+    if os.environ.get("APEX_TPU_FORCE_COMPILED") == "1":
+        return False
     return not platform_is_tpu()

@@ -1,0 +1,41 @@
+"""Deviceless Mosaic compile regression (VERDICT r4 item 2).
+
+The interpret-mode suite is blind to Mosaic compile errors (layout, tiling,
+VMEM budget) — tools/mosaic_aot.py compiles the whole kernel zoo against a
+compile-only v5e topology built from the baked-in libtpu, no chip or relay
+needed. This test keeps that property green: every kernel tag must compile.
+
+(The round-4 relay outage proved the need: the RDMA halo kernel carried a
+tile-misaligned HBM slice for two rounds that interpret mode executed
+happily and Mosaic rejects outright.)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_kernel_zoo_compiles_for_v5e(tmp_path):
+    env = dict(os.environ)
+    kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(kept + [ROOT])
+    env["JAX_PLATFORMS"] = "cpu"
+    out = tmp_path / "MOSAIC_AOT.json"
+    env["MOSAIC_AOT_OUT"] = str(out)  # never clobber the committed artifact
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "mosaic_aot.py")],
+        env=env, capture_output=True, text=True, timeout=850, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-3000:]
+    art = json.load(open(out))
+    assert art["ok"] is True
+    failed = [
+        f"{k}:{t}" for k, rec in art["kernels"].items()
+        for t, e in rec["tags"].items() if not e["ok"]]
+    assert not failed, failed
+    # the multi-device RDMA ring and ring attention must be among them
+    assert "remote_copy" in art["kernels"]
+    assert "ring_attention" in art["kernels"]
