@@ -32,10 +32,26 @@ moment update —
 Both are numerically identical (tests assert this), they differ only in
 which collectives the compiled module contains.
 
-- ``fused_norm``/``fuse_scale`` (:119, :171): the L2 norm and the
-  ``inv_scale`` multiply are ALREADY fused into the single jitted step here
-  (XLA fuses the norm's partial-sum into the scale pass); the kwargs are
-  accepted for API parity and validated, not dispatched.
+Clip point (reference :818/:944 vs :976-996, selected by ``clip_after_ar``):
+- ``clip_after_ar=True`` (default): one global L2 norm of the synced flat
+  gradient, clip by ``max_grad_norm`` — the reference's post-all-reduce
+  clip (:944-975, kernel-side via ``max_grad_norm * clip_after_ar`` :1073).
+- ``clip_after_ar=False``: the reference clips each rank's gradient by a
+  norm computed BEFORE the sync (:981-996) so the clip coefficient never
+  waits on a collective. Under GSPMD the pre-sync view of the flat buffer
+  is the device's own 1-D shard, so the TPU translation clips each flat
+  SHARD by its own local norm — ``coeff_i = min(1, max_grad_norm /
+  (1e-6 + ||g_shard_i||))`` computed shard-locally (the (world, n/world)
+  reshape aligns rows with the P(axis) shards; XLA lowers the row norms
+  collective-free, the property this mode exists for). Like the
+  reference's, this clip is per-device-inconsistent by design — numerics
+  tests pin both points.
+- ``fused_norm`` (:119,:176) only applies when clipping pre-AR (the norm
+  fuses into the scale pass); here the local-shard norm IS emitted inside
+  the single jitted step (XLA fuses it), so the kwarg selects dispatched
+  behavior exactly when the reference's does. ``fuse_scale`` (:171): the
+  ``inv_scale`` multiply is always fused into the step; accepted for API
+  parity and validated, not dispatched.
 - ``set_is_accumulation_step(True)`` (:787) makes step() ACCUMULATE: grads
   are added into a sharded flat accumulation buffer (shard-local adds; under
   GSPMD grad-sum placement belongs to the caller's backward) and the next
@@ -146,6 +162,10 @@ class DistributedFusedLAMB:
         # replicated ⇒ all-reduce-shaped (full_ar), sharded ⇒
         # reduce-scatter-shaped (RS+AR). Numerics are identical.
         grad_sharding = rep_s if self.full_ar else shard_s
+        clip_after_ar = self.clip_after_ar
+        world = self.mesh.shape[self.axis]
+        # row i of the (world, n/world) view IS device i's flat shard
+        row_s = NamedSharding(self.mesh, P(self.axis, None))
 
         def step_fn(p32, m, v, grads, acc, step, lr, inv_scale, found_inf):
             flat_g = flatten(grads, spec, dtype=_f32, pad_to=n)
@@ -157,10 +177,25 @@ class DistributedFusedLAMB:
                 g32 = g32 + jax.lax.with_sharding_constraint(
                     acc, grad_sharding)
 
-            # fused global grad norm + clip (padding is zero ⇒ exact)
-            gnorm = jnp.sqrt(jnp.sum(g32 * g32))
-            clip = jnp.maximum(gnorm / max_gn, 1.0) if max_gn else _f32(1.0)
-            g32 = g32 / clip
+            if clip_after_ar or not max_gn:
+                # fused global grad norm + clip (padding is zero ⇒ exact)
+                gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+                clip = (jnp.maximum(gnorm / max_gn, 1.0) if max_gn
+                        else _f32(1.0))
+                g32 = g32 / clip
+            else:
+                # pre-AR clip (reference :981-996): each device clips its
+                # own flat shard by the shard-local norm — the (world, ·)
+                # rows coincide with the P(axis) shards, so no collective
+                # feeds the clip coefficient (fused_norm dispatched)
+                gsh = jax.lax.with_sharding_constraint(
+                    g32.reshape(world, n // world), row_s)
+                local = jnp.sqrt(jnp.sum(gsh * gsh, axis=1, keepdims=True))
+                coeff = jnp.minimum(max_gn / (1e-6 + local), 1.0)
+                g32 = (gsh * coeff).reshape(n)
+                g32 = jax.lax.with_sharding_constraint(g32, grad_sharding)
+                # reported norm stays the true global pre-clip norm
+                gnorm = jnp.sqrt(jnp.sum(local * local))
 
             if not adam_w:
                 g32 = g32 + wd * p32

@@ -323,6 +323,85 @@ class TestDistributedFusedLAMB:
         # structure must differ
         assert ops[False]["all-gather"] != ops[True]["all-gather"], ops
 
+    def test_clip_after_ar_uses_global_norm(self, mesh):
+        """clip_after_ar=True (reference :944-975): one global L2 norm of
+        the synced gradient; a step at max_grad_norm=1 equals a no-clip
+        step on grads pre-scaled by that global norm."""
+        g = [10.0 * x for x in _grads(1)]
+        gnorm = np.sqrt(sum(float(np.sum(np.square(np.asarray(x))))
+                            for x in g))
+        assert gnorm > 1.0  # the clip must actually engage
+        dopt = DistributedFusedLAMB(_params(), mesh, lr=1e-2,
+                                    weight_decay=0.01, max_grad_norm=1.0,
+                                    clip_after_ar=True)
+        dopt.step(g)
+        ref = DistributedFusedLAMB(_params(), mesh, lr=1e-2,
+                                   weight_decay=0.01, max_grad_norm=0.0)
+        ref.step([x / gnorm for x in g])
+        for a, b in zip(dopt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, rtol=1e-6)
+
+    @staticmethod
+    def _shard_spanning(seed, scales=(1.0, 1.0, 1.0)):
+        """Params/grads big enough that the flat buffer's real data spans
+        several of the 8 flat shards (the tiny module-level SHAPES all fit
+        in shard 0, where per-shard and global clips coincide)."""
+        shapes = [(3000,), (2500,), (700,)]
+        ks = jax.random.split(jax.random.PRNGKey(seed), len(shapes))
+        return [s * jax.random.normal(k, sh, jnp.float32)
+                for s, k, sh in zip(scales, ks, shapes)]
+
+    def test_clip_before_ar_uses_local_shard_norms(self, mesh):
+        """clip_after_ar=False (reference :981-996): each device clips its
+        own flat shard by the shard-local norm — no collective feeds the
+        clip coefficient. Verified against a manual per-shard clip of the
+        same flat layout fed to a no-clip optimizer."""
+        from apex_tpu.utils.flatten import flat_spec, flatten, unflatten
+
+        params = self._shard_spanning(0)
+        g = self._shard_spanning(7, scales=(5.0, 0.01, 3.0))
+        dopt = DistributedFusedLAMB(params, mesh, lr=1e-2,
+                                    weight_decay=0.01, max_grad_norm=1.0,
+                                    clip_after_ar=False)
+        dopt.step(g)
+
+        world = mesh.shape["data"]
+        spec = flat_spec(params)
+        fg = np.asarray(flatten(g, spec, dtype=jnp.float32, pad_to=dopt._n))
+        rows = fg.reshape(world, dopt._n // world)
+        local = np.sqrt((rows ** 2).sum(axis=1, keepdims=True))
+        assert (local > 1.0).any()  # some shards must clip...
+        assert (local <= 1.0).any()  # ...and some must not
+        coeff = np.minimum(1.0 / (1e-6 + local), 1.0)
+        clipped = unflatten(jnp.asarray((rows * coeff).reshape(-1),
+                                        jnp.float32), spec)
+        ref = DistributedFusedLAMB(self._shard_spanning(0), mesh, lr=1e-2,
+                                   weight_decay=0.01, max_grad_norm=0.0)
+        ref.step(clipped)
+        for a, b in zip(dopt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_clip_points_differ_when_energy_is_concentrated(self, mesh):
+        """A gradient whose energy sits in one flat shard must clip
+        DIFFERENTLY at the two clip points (the reference's pre-AR clip is
+        per-rank-inconsistent by design) — guards against clip_after_ar
+        silently collapsing to one path."""
+        # hot first tensor, cold rest: the global clip crushes the cold
+        # shards, the local clip leaves them alone
+        g = self._shard_spanning(9, scales=(20.0, 0.05, 0.05))
+        outs = {}
+        for flag in (True, False):
+            o = DistributedFusedLAMB(self._shard_spanning(0), mesh,
+                                     lr=1e-2, max_grad_norm=1.0,
+                                     clip_after_ar=flag)
+            o.step(g)
+            outs[flag] = [np.asarray(p) for p in o.parameters]
+        assert not all(
+            np.allclose(a, b, atol=1e-7)
+            for a, b in zip(outs[True], outs[False]))
+
 
 class TestRedundant2DGrid:
     def test_state_sharded_over_data_replicated_over_redundant(self):
