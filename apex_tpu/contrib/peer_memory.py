@@ -4,11 +4,18 @@
 ``peer_memory_cuda`` IPC kernels (peer_memory.cpp:20-34,
 ``push_pull_halos_1d``).
 
-On TPU there is no user-managed device memory: XLA owns buffers and
-chip-to-chip one-sided writes are what ``ppermute`` compiles to over ICI
-(SURVEY §5 comm backend mapping). ``PeerMemoryPool`` therefore carries only
-the bookkeeping surface (sizes/alignment) so reference call sites port
-mechanically, and the halo exchanger delegates to apex_tpu.parallel.halo.
+On TPU chip-to-chip one-sided writes are what ``ppermute`` compiles to
+over ICI (SURVEY §5 comm backend mapping), and "peer memory" is the SPMD
+identification: every rank runs the same program, so the buffer a remote
+DMA lands in on rank r IS rank r's instance of the allocation.
+``PeerMemoryPool`` is therefore a real single-HBM-arena allocator — one
+device allocation up front (the analog of ``pm.allocate_raw``,
+peer_memory.py:31), 256-byte-aligned static/dynamic bump sub-allocation
+with the reference's exhaustion asserts, and per-peer views that are
+genuine device arrays. Pool buffers plug into the RDMA halo exchange as
+DONATED landing buffers (``halo_exchange_rdma(..., bufs=...)``), giving
+the reference pool's actual purpose: remote puts land in preallocated
+storage, no fresh HBM allocation per iteration.
 
 ``transport="rdma"`` routes the exchange through an explicit Pallas
 one-sided remote DMA (``ops/pallas/remote_copy.halo_exchange_rdma``) —
@@ -25,27 +32,116 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.parallel.halo import halo_exchange_1d, left_right_halo_exchange
 
 
 class PeerMemoryPool:
-    """API-parity shim (peer_memory.py:29-42). Allocation is XLA's job; the
-    pool records the requested static/dynamic sizes for introspection."""
+    """Real TPU peer-memory arena (reference peer_memory.py:6-106).
+
+    One up-front HBM allocation of ``static_size + dynamic_size`` bytes
+    (``pm.allocate_raw`` :31), bump-allocated at 256-byte alignment with
+    the reference's static/dynamic split and exhaustion asserts (:53-106).
+    ``allocate_peer_tensors`` returns one device array per peer rank —
+    under SPMD these are each rank's instance of the same arena slice,
+    which is exactly the storage a one-sided remote DMA writes into
+    (``ops/pallas/remote_copy``). ``channels_last`` is accepted and
+    recorded for call-site parity; physical layout is XLA's (there is no
+    NCHW-vs-NHWC distinction to honor on a logical view).
+    """
 
     def __init__(self, static_size: int = 0, dynamic_size: int = 0,
                  peer_ranks=None):
-        self.static_size = static_size
-        self.dynamic_size = dynamic_size
-        self.peer_ranks = peer_ranks
         self.alignment = 256
+        a = self.alignment
+        self.static_size = (static_size + a - 1) // a * a
+        self.dynamic_size = (dynamic_size + a - 1) // a * a
+        self.peer_ranks = list(peer_ranks) if peer_ranks is not None else [0]
+        # the arena: ONE device allocation, sub-allocated below
+        self._raw = jnp.zeros((max(self.static_size + self.dynamic_size,
+                                   1),), jnp.uint8)
+        self.static_offset = 0
+        self.dynamic_offset = 0
+        self.allocations: list[dict] = []
+
+    def reset(self):
+        """Free all dynamic sub-allocations (reference :50-51). Records
+        stay in place (marked freed) so positional indices held by
+        callers — e.g. PeerHaloExchanger1d's cached landing-buffer
+        indices — remain stable."""
+        self.dynamic_offset = 0
+        for r in self.allocations:
+            if r["dynamic"]:
+                r["freed"] = True
+
+    def free(self):
+        """Drop the arena (``pm.free_raw`` :47-48 analog)."""
+        self._raw = None
+
+    def _view(self, start: int, shape, dtype):
+        nbytes = int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+        flat = jax.lax.slice(self._raw, (start,), (start + nbytes,))
+        if jnp.dtype(dtype).itemsize == 1:
+            out = flat.astype(dtype)
+        else:
+            out = jax.lax.bitcast_convert_type(
+                flat.reshape(-1, jnp.dtype(dtype).itemsize), dtype)
+        return out.reshape(shape)
 
     def allocate_peer_tensors(self, shape, dtype, channels_last: bool,
                               dynamic: bool):
-        raise NotImplementedError(
-            "TPU has no user-managed peer memory: the peer-put CAPABILITY "
-            "is PeerHaloExchanger1d(transport='rdma') (a Pallas one-sided "
-            "remote DMA), or apex_tpu.parallel.halo's ppermute path.")
+        """Sub-allocate ``shape``/``dtype`` from the arena; returns one
+        device array per peer rank (reference :53-106)."""
+        if self._raw is None:
+            raise RuntimeError("pool was freed")
+        nbytes = int(np.prod(shape)) * jnp.dtype(dtype).itemsize
+        a = self.alignment
+        if dynamic:
+            start = (self.dynamic_offset + a - 1) // a * a
+            self.dynamic_offset = start + nbytes
+            assert self.dynamic_offset < self.dynamic_size, \
+                "Dynamic peer memory pool exhausted"
+            base = self.static_size + start
+        else:
+            start = (self.static_offset + a - 1) // a * a
+            self.static_offset = start + nbytes
+            assert self.static_offset < self.static_size, \
+                "Static peer memory pool exhausted"
+            base = start
+        self.allocations.append(
+            {"shape": tuple(shape), "dtype": jnp.dtype(dtype).name,
+             "offset": base, "nbytes": nbytes, "dynamic": dynamic,
+             "channels_last": bool(channels_last)})
+        return [self._view(base, shape, dtype) for _ in self.peer_ranks]
+
+    def view(self, alloc_index: int):
+        """Re-materialize the device view of a prior sub-allocation (the
+        record survives donation of an earlier view — the arena itself is
+        never donated)."""
+        if self._raw is None:
+            raise RuntimeError("pool was freed")
+        r = self.allocations[alloc_index]
+        if r.get("freed"):
+            raise RuntimeError(
+                f"allocation {alloc_index} was freed by reset()")
+        return self._view(r["offset"], r["shape"], jnp.dtype(r["dtype"]))
+
+    def allocate_halo_buffers(self, x_shape, halo: int, dtype,
+                              dynamic: bool = False):
+        """Landing buffers for ``halo_exchange_rdma(..., bufs=...)`` —
+        shaped by ``halo_buf_rows`` so remote puts land in pool storage.
+        Returns ``(lo, hi, (idx_lo, idx_hi))``; the indices re-materialize
+        the views via :meth:`view` after a donating call."""
+        from apex_tpu.ops.pallas.remote_copy import halo_buf_rows
+
+        rows = halo_buf_rows(x_shape[0], halo, dtype)
+        shape = (rows,) + tuple(x_shape[1:])
+        lo = self.allocate_peer_tensors(shape, dtype, False, dynamic)[0]
+        idx_lo = len(self.allocations) - 1
+        hi = self.allocate_peer_tensors(shape, dtype, False, dynamic)[0]
+        idx_hi = len(self.allocations) - 1
+        return lo, hi, (idx_lo, idx_hi)
 
 
 class PeerHaloExchanger1d:
@@ -65,6 +161,22 @@ class PeerHaloExchanger1d:
         self.axis_name = axis_name
         self.half_halo = half_halo
         self.transport = transport
+        self.peer_pool = peer_pool
+        self._pool_bufs: dict = {}  # (shape, dtype) -> (idx_lo, idx_hi)
+
+    def _landing_bufs(self, strip_shape, dtype, halo):
+        """RDMA landing buffers from the peer pool (allocated once per
+        shape/dtype, views re-materialized after donation)."""
+        if self.peer_pool is None:
+            return None
+        key = (tuple(strip_shape), jnp.dtype(dtype).name)
+        if key not in self._pool_bufs:
+            lo, hi, idxs = self.peer_pool.allocate_halo_buffers(
+                strip_shape, halo, dtype)
+            self._pool_bufs[key] = idxs
+            return lo, hi
+        idx_lo, idx_hi = self._pool_bufs[key]
+        return self.peer_pool.view(idx_lo), self.peer_pool.view(idx_hi)
 
     def left_right_halo_exchange(self, left_output_halo, right_output_halo):
         if self.transport == "rdma":
@@ -79,7 +191,8 @@ class PeerHaloExchanger1d:
                     f"{h} vs {right_output_halo.shape[0]} rows — use "
                     "transport='collective' for asymmetric strips")
             both = jnp.concatenate([left_output_halo, right_output_halo], 0)
-            lo, hi = halo_exchange_rdma(both, self.axis_name, h)
+            bufs = self._landing_bufs(both.shape, both.dtype, h)
+            lo, hi = halo_exchange_rdma(both, self.axis_name, h, bufs=bufs)
             return lo, hi
         return left_right_halo_exchange(left_output_halo, right_output_halo,
                                         self.axis_name)
@@ -98,7 +211,8 @@ class PeerHaloExchanger1d:
                                           axis=spatial_axis)
             both = jnp.concatenate([top, bottom], axis=spatial_axis)
             both = jnp.moveaxis(both, spatial_axis, 0)
-            lo, hi = halo_exchange_rdma(both, self.axis_name, h)
+            bufs = self._landing_bufs(both.shape, both.dtype, h)
+            lo, hi = halo_exchange_rdma(both, self.axis_name, h, bufs=bufs)
             lo = jnp.moveaxis(lo, 0, spatial_axis)
             hi = jnp.moveaxis(hi, 0, spatial_axis)
             return jnp.concatenate([lo, x, hi], axis=spatial_axis)

@@ -66,6 +66,27 @@ def _tile_rows(dtype) -> int:
     return {1: 32, 2: 16}.get(jnp.dtype(dtype).itemsize, 8)
 
 
+def _halo_plan(rows: int, halo: int, dtype) -> tuple[int, bool, int]:
+    """(send_rows, full, buf_rows) for a ``(rows, ...)`` halo exchange —
+    the single source of the landing-buffer shape contract shared by
+    ``halo_exchange_rdma`` and ``halo_buf_rows``."""
+    t = _tile_rows(dtype)
+    send_rows = -(-halo // t) * t  # halo rounded up to the sublane tile
+    # whole-ref transfer when the shard is too small for an aligned edge
+    # slice (also covers shards whose row count breaks the high-edge
+    # slice's tile alignment)
+    full = send_rows >= rows or rows % t != 0
+    return send_rows, full, (rows if full else send_rows)
+
+
+def halo_buf_rows(rows: int, halo: int, dtype) -> int:
+    """Rows of the landing buffer ``halo_exchange_rdma`` uses for a
+    ``(rows, ...)`` input — whole sublane tiles, or the full ref when the
+    shard is small/unaligned. Exposed so callers (PeerMemoryPool) can
+    pre-allocate aliasable landing buffers of the right shape."""
+    return _halo_plan(rows, halo, dtype)[2]
+
+
 def _halo_kernel(x_ref, lo_ref, hi_ref, slo, shi, rlo, rhi, *,
                  axis_name, send_rows, full):
     """Send my low edge to the LEFT neighbor's ``hi`` buffer and my high
@@ -103,37 +124,68 @@ def _halo_kernel(x_ref, lo_ref, hi_ref, slo, shi, rlo, rhi, *,
 
 def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
                        periodic: bool = False,
+                       bufs=None,
                        interpret: bool | None = None):
     """1-D halo exchange over leading axis via peer RDMA puts: returns
     ``(lo, hi)`` — the ``halo`` rows received from the left and right
     neighbors (≈ ``PeerHaloExchanger1d`` over a ``PeerMemoryPool``,
     peer_halo_exchanger_1d.py). ``periodic=False`` zeroes the wrap-around
     halos at the ring edges, matching the halo exchangers' boundary
-    convention in ``parallel.halo``."""
+    convention in ``parallel.halo``.
+
+    ``bufs=(lo_buf, hi_buf)`` — optional pre-allocated landing buffers of
+    shape ``(halo_buf_rows(rows, halo, dtype),) + x.shape[1:]`` (e.g. from
+    a PeerMemoryPool arena). They are DONATED: the remote puts land in
+    their storage via input/output aliasing instead of fresh HBM each call
+    — the reference peer pool's no-per-iteration-allocation property
+    (peer_memory.py:29-42)."""
     if interpret is None:
         interpret = interpret_default()
     rows = x.shape[0]
-    t = _tile_rows(x.dtype)
-    send_rows = -(-halo // t) * t  # halo rounded up to the sublane tile
-    # whole-ref transfer when the shard is too small for an aligned edge
-    # slice (also covers shards whose row count breaks the high-edge
-    # slice's tile alignment)
-    full = send_rows >= rows or rows % t != 0
-    buf_rows = rows if full else send_rows
-    lo_buf, hi_buf = pl.pallas_call(
-        functools.partial(_halo_kernel, axis_name=axis_name,
-                          send_rows=send_rows, full=full),
-        out_shape=[
-            jax.ShapeDtypeStruct((buf_rows,) + x.shape[1:], x.dtype),
-            jax.ShapeDtypeStruct((buf_rows,) + x.shape[1:], x.dtype),
-        ],
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=[pl.BlockSpec(memory_space=pl.ANY),
-                   pl.BlockSpec(memory_space=pl.ANY)],
-        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
-                        pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
-        interpret=interpret,
-    )(x)
+    send_rows, full, buf_rows = _halo_plan(rows, halo, x.dtype)
+    kernel = functools.partial(_halo_kernel, axis_name=axis_name,
+                               send_rows=send_rows, full=full)
+    out_shape = [
+        jax.ShapeDtypeStruct((buf_rows,) + x.shape[1:], x.dtype),
+        jax.ShapeDtypeStruct((buf_rows,) + x.shape[1:], x.dtype),
+    ]
+    out_specs = [pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pl.ANY)]
+    sems = [pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA,
+            pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA]
+    if bufs is not None:
+        lo_in, hi_in = bufs
+        want = (buf_rows,) + x.shape[1:]
+        if lo_in.shape != want or hi_in.shape != want or \
+                lo_in.dtype != x.dtype or hi_in.dtype != x.dtype:
+            raise ValueError(
+                f"landing buffers must be {want} {x.dtype} (use "
+                f"halo_buf_rows); got {lo_in.shape}/{hi_in.shape} "
+                f"{lo_in.dtype}")
+
+        def kernel_aliased(x_ref, lo_in_ref, hi_in_ref, lo_ref, hi_ref,
+                           *sems_):
+            del lo_in_ref, hi_in_ref  # same storage as lo_ref/hi_ref
+            kernel(x_ref, lo_ref, hi_ref, *sems_)
+
+        lo_buf, hi_buf = pl.pallas_call(
+            kernel_aliased,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 3,
+            out_specs=out_specs,
+            scratch_shapes=sems,
+            input_output_aliases={1: 0, 2: 1},
+            interpret=interpret,
+        )(x, lo_in, hi_in)
+    else:
+        lo_buf, hi_buf = pl.pallas_call(
+            kernel,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=out_specs,
+            scratch_shapes=sems,
+            interpret=interpret,
+        )(x)
     # the landed buffers carry whole tiles; the true halo is the left
     # neighbor's LAST rows / right neighbor's FIRST rows
     lo = jax.lax.slice_in_dim(lo_buf, buf_rows - halo, buf_rows, axis=0)

@@ -87,6 +87,15 @@ def roofline(fn, *args, chip: str | None = None,
     when ``measured_ms`` is given, ``achieved_frac`` (ideal/measured —
     how close the step runs to its own roofline) and the per-resource
     fractions. ``chip`` defaults to ``PALLAS_AXON_TPU_GEN`` (v5e).
+
+    Caveat on ``bytes``: XLA's "bytes accessed" counts every operand's
+    bytes per op, including VMEM-resident reuse that never touches HBM,
+    so ``t_hbm_ms`` is an UPPER bound on memory time and fusion-heavy
+    programs (conv nets) can legitimately run faster than ``ideal_ms`` —
+    ``achieved_frac > 1`` means "beat the operand-byte model", not an
+    error (observed: ResNet-50 b128 measures 55 ms vs a 79 ms
+    operand-byte bound). ``t_mxu_ms`` has no such slack; ``mxu_frac`` is
+    the trustworthy utilization number for compute-bound steps.
     """
     import os
 

@@ -621,3 +621,64 @@ class TestASPCheckpointFlow:
             p2 = opt2.step(g)
         # sparsity maintained through post-restore training
         np.testing.assert_array_equal(np.asarray(p2[0])[~m], 0.0)
+
+
+class TestPeerMemoryPool:
+    """Real arena semantics (reference peer_memory.py:6-106): one device
+    allocation, aligned bump sub-allocation, exhaustion asserts, dynamic
+    reset, per-peer device views."""
+
+    def test_allocation_accounting_and_views(self):
+        from apex_tpu.contrib.peer_memory import PeerMemoryPool
+        pool = PeerMemoryPool(static_size=4096, dynamic_size=4096,
+                              peer_ranks=[0, 1, 2])
+        ts = pool.allocate_peer_tensors((8, 16), jnp.float32,
+                                        channels_last=False, dynamic=False)
+        assert len(ts) == 3  # one view per peer rank
+        assert ts[0].shape == (8, 16) and ts[0].dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(ts[0]), 0.0)
+        # second static allocation starts at an aligned, disjoint offset
+        t2 = pool.allocate_peer_tensors((4, 4), jnp.bfloat16,
+                                        channels_last=True, dynamic=False)
+        r0, r1 = pool.allocations
+        assert r1["offset"] % pool.alignment == 0
+        assert r1["offset"] >= r0["offset"] + r0["nbytes"]
+        assert r1["channels_last"] is True
+        assert t2[0].dtype == jnp.bfloat16
+        # dynamic allocations live in the dynamic half and reset() drops
+        # only them
+        pool.allocate_peer_tensors((16,), jnp.int32, False, dynamic=True)
+        assert pool.allocations[-1]["offset"] >= pool.static_size
+        assert pool.dynamic_offset > 0
+        pool.reset()
+        assert pool.dynamic_offset == 0
+        # records stay positionally stable: dynamic ones are marked freed
+        # (cached indices keep resolving), statics stay live
+        assert len(pool.allocations) == 3
+        assert pool.allocations[2]["freed"]
+        with pytest.raises(RuntimeError, match="freed by reset"):
+            pool.view(2)
+        pool.view(0)  # static index still valid after reset
+
+    def test_exhaustion_asserts(self):
+        from apex_tpu.contrib.peer_memory import PeerMemoryPool
+        pool = PeerMemoryPool(static_size=1024, dynamic_size=512)
+        with pytest.raises(AssertionError, match="Static"):
+            pool.allocate_peer_tensors((1024,), jnp.float32, False, False)
+        with pytest.raises(AssertionError, match="Dynamic"):
+            pool.allocate_peer_tensors((512,), jnp.float32, False, True)
+
+    def test_view_rematerializes(self):
+        from apex_tpu.contrib.peer_memory import PeerMemoryPool
+        pool = PeerMemoryPool(static_size=4096)
+        t = pool.allocate_peer_tensors((8, 8), jnp.float32, False, False)[0]
+        again = pool.view(0)
+        assert again.shape == t.shape and again.dtype == t.dtype
+        np.testing.assert_array_equal(np.asarray(again), np.asarray(t))
+
+    def test_freed_pool_refuses(self):
+        from apex_tpu.contrib.peer_memory import PeerMemoryPool
+        pool = PeerMemoryPool(static_size=1024)
+        pool.free()
+        with pytest.raises(RuntimeError):
+            pool.allocate_peer_tensors((4,), jnp.float32, False, False)

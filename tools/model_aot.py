@@ -1,0 +1,212 @@
+"""Deviceless Mosaic/XLA AOT compile of the FULL bench model steps.
+
+Companion to tools/mosaic_aot.py (kernel zoo): compiles the exact
+BASELINE configs 2/4/5 bench programs — ResNet-50 b128@224 train step,
+BERT-large b32 s128 LAMB train step, GPT-2 1.5B b4 s512 bf16 forward —
+against a compile-only v5e client built from the baked-in libtpu. Proves
+the headline bench programs compile for TPU (layout, VMEM, HBM fit)
+before any chip time is spent, and records XLA's own cost model
+(flops/bytes per step) plus the roofline-implied step-time bounds as
+committed evidence (MODEL_AOT.json).
+
+HBM-fit check: ``memory_analysis`` argument+temp+output bytes must fit
+the 16 GB v5e HBM, the compile-time analog of the OOM the bench would
+hit live.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+os.environ["APEX_TPU_FORCE_COMPILED"] = "1"
+os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
+os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import SingleDeviceSharding  # noqa: E402
+
+from bench import atomic_write_json  # noqa: E402
+
+OUT_PATH = os.environ.get("MODEL_AOT_OUT",
+                          os.path.join(ROOT, "MODEL_AOT.json"))
+HBM_BYTES = 16e9  # v5e
+
+
+def _structs(tree, s):
+    return jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype, sharding=s), tree)
+
+
+def case_resnet50(s):
+    """BASELINE config 2: the exact q050/bench ResNet-50 train step."""
+    from apex_tpu.models.resnet import ResNet50
+    from apex_tpu.optimizers.functional import adam_update
+
+    model, batch, hw, ncls = ResNet50(), 128, 224, 1000
+    x = jax.ShapeDtypeStruct((batch, hw, hw, 3), jnp.bfloat16, sharding=s)
+    y = jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=s)
+    vs = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((batch, hw, hw, 3), jnp.bfloat16)),
+        jax.random.PRNGKey(0))
+    params, bstats = _structs(vs["params"], s), _structs(vs["batch_stats"], s)
+    mom = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32, sharding=s),
+        params)
+
+    def step(state, x, y):
+        p, m, v, bs = state
+
+        def loss_fn(p):
+            logits, upd = model.apply({"params": p, "batch_stats": bs}, x,
+                                      mutable=["batch_stats"])
+            onehot = jax.nn.one_hot(y, logits.shape[-1])
+            loss = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot,
+                                     axis=-1))
+            return loss, upd["batch_stats"]
+
+        (_, bs2), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        p, m, v = adam_update(p, grads, m, v, step=1, lr=1e-3,
+                              weight_decay=1e-4)
+        return (p, m, v, bs2)
+
+    return step, ((params, mom, mom, bstats), x, y)
+
+
+def case_bert_lamb(s):
+    """BASELINE config 4: BERT-large b32 s128 LAMB train step."""
+    from apex_tpu.models.bert import Bert, BertConfig
+    from apex_tpu.optimizers.functional import lamb_update
+
+    cfg, batch, seq = BertConfig.large(), 32, 128
+    model = Bert(cfg)
+    tokens = jax.ShapeDtypeStruct((batch, seq), jnp.int32, sharding=s)
+    vs = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((batch, seq), jnp.int32)),
+        jax.random.PRNGKey(0))
+    params = _structs(vs["params"], s)
+    mom = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32, sharding=s),
+        params)
+
+    def step(state, tokens, labels):
+        p, m, v = state
+
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            onehot = jax.nn.one_hot(labels, logits.shape[-1])
+            return -jnp.mean(jnp.sum(
+                jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot,
+                axis=-1))
+
+        _, grads = jax.value_and_grad(loss_fn)(p)
+        p, m, v, _g = lamb_update(p, grads, m, v, step=1, lr=1e-3,
+                                  weight_decay=0.01)
+        return (p, m, v)
+
+    return step, ((params, mom, mom), tokens, tokens)
+
+
+def case_gpt2_fwd(s):
+    """BASELINE config 5: GPT-2 1.5B bf16 forward, b4 s512."""
+    from apex_tpu.models.gpt2 import GPT2, GPT2Config
+
+    cfg = GPT2Config.xl()
+    cfg = type(cfg)(**{**cfg.__dict__, "n_positions": 512})
+    batch, seq = 4, 512
+    model = GPT2(cfg)
+    tokens = jax.ShapeDtypeStruct((batch, seq), jnp.int32, sharding=s)
+    vs = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((batch, seq), jnp.int32)),
+        jax.random.PRNGKey(0))
+    params = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(
+            l.shape, jnp.bfloat16 if l.dtype == jnp.float32 else l.dtype,
+            sharding=s), vs)
+
+    def step(params, tokens):
+        return jnp.sum(model.apply(params, tokens).astype(jnp.float32))
+
+    return step, (params, tokens)
+
+
+CASES = [("resnet50_b128_train", case_resnet50),
+         ("bert_large_b32_lamb_train", case_bert_lamb),
+         ("gpt2_xl_b4_s512_fwd", case_gpt2_fwd)]
+
+
+def main():
+    t0 = time.time()
+    topo = topologies.get_topology_desc(
+        os.environ.get("MOSAIC_AOT_TOPOLOGY", "v5e:2x2"), "tpu")
+    s = SingleDeviceSharding(topo.devices[0])
+    chip = {"tflops": 394.0, "hbm_gbps": 819.0}  # v5e bf16 peaks
+    result = {"device_kind": getattr(topo.devices[0], "device_kind", "?"),
+              "jax": jax.__version__,
+              "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
+              "models": {}}
+    ok_all = True
+    for name, make in CASES:
+        t1 = time.time()
+        try:
+            fn, args = make(s)
+            compiled = jax.jit(fn).lower(*args).compile()
+            entry = {"ok": True}
+            try:
+                ca = compiled.cost_analysis()
+                ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+                fl = float(ca.get("flops", 0.0))
+                by = float(ca.get("bytes accessed", 0.0))
+                entry["flops_per_step"] = fl
+                entry["bytes_accessed"] = by
+                entry["t_mxu_ms"] = round(fl / (chip["tflops"] * 1e12) * 1e3,
+                                          2)
+                # upper bound only — operand bytes include VMEM reuse (see
+                # utils/prof.roofline docstring)
+                entry["t_hbm_upper_ms"] = round(
+                    by / (chip["hbm_gbps"] * 1e9) * 1e3, 2)
+            except Exception as e:
+                entry["cost_analysis_error"] = str(e)[:200]
+            try:
+                mem = compiled.memory_analysis()
+                total = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
+                         + mem.output_size_in_bytes)
+                entry["hbm_total_bytes"] = int(total)
+                entry["fits_hbm"] = bool(total < HBM_BYTES)
+                if not entry["fits_hbm"]:
+                    entry["ok"] = False
+            except Exception as e:
+                entry["memory_analysis_error"] = str(e)[:200]
+        except Exception as e:
+            entry = {"ok": False,
+                     "error": f"{type(e).__name__}: {str(e)[:1500]}"}
+        entry["wall_s"] = round(time.time() - t1, 1)
+        ok_all = ok_all and entry["ok"]
+        result["models"][name] = entry
+        print(f"[model_aot] {name} "
+              f"{'OK' if entry['ok'] else 'FAIL ' + entry.get('error', '')}"
+              f" ({entry['wall_s']}s)", file=sys.stderr, flush=True)
+        result["ok"] = False
+        result["wall_s"] = round(time.time() - t0, 1)
+        atomic_write_json(OUT_PATH, result)
+    result["ok"] = ok_all
+    result["wall_s"] = round(time.time() - t0, 1)
+    atomic_write_json(OUT_PATH, result)
+    print(json.dumps({"ok": ok_all, "wall_s": result["wall_s"]}))
+    sys.exit(0 if ok_all else 2)
+
+
+if __name__ == "__main__":
+    main()

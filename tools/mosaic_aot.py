@@ -226,6 +226,23 @@ def build_cases(dev_sharding, mesh):
                                 out_specs=(P("x"), P("x"), P("x")),
                                 check_vma=False)(x), xr)
 
+    # pool-backed landing buffers: remote puts must alias into donated
+    # storage (input_output_aliases through shard_map → Mosaic)
+    from apex_tpu.ops.pallas.remote_copy import halo_buf_rows
+
+    per_dev_rows = 64 // mesh.shape["x"]
+    br = halo_buf_rows(per_dev_rows, 2, jnp.float32)
+    buf = _struct((br * mesh.shape["x"], 2048), jnp.float32, ns)
+
+    def rdma_pool_body(x, lo_in, hi_in):
+        return halo_exchange_rdma(x, "x", 2, bufs=(lo_in, hi_in))
+
+    add("remote_copy", "ring4_halo_pool_bufs",
+        lambda x, lo, hi: jax.shard_map(
+            rdma_pool_body, mesh=mesh, in_specs=(P("x"), P("x"), P("x")),
+            out_specs=(P("x"), P("x")), check_vma=False)(x, lo, hi),
+        xr, buf, buf)
+
     # ---- beyond chipcheck: ring attention over the topology mesh -------
     from apex_tpu.parallel.ring_attention import ring_attention
 
