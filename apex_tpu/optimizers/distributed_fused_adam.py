@@ -63,6 +63,18 @@ _SCALE_BLOCK = 1024  # with_scaled_states: elements per fp32 scale factor
 _F16_MAX = 65504.0
 
 
+def _state_put(abstract: bool):
+    """State placement for the ZeRO optimizers: ``jax.device_put``, or —
+    for ``abstract_state=True`` compile-only instances — a sharded
+    ShapeDtypeStruct builder (no runtime buffers), so the step can be
+    AOT-lowered against a deviceless topology mesh (tools/stack_aot.py).
+    Shared with DistributedFusedLAMB."""
+    if abstract:
+        return lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                                 sharding=s)
+    return jax.device_put
+
+
 def _split_f32(x32: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """fp32 → (bf16 high bits, int16 low bits) — exact decomposition."""
     bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
@@ -130,7 +142,7 @@ class DistributedFusedAdam:
                  overlap_grad_sync: bool = True,
                  overlap_param_sync: bool = True,
                  bucket_cap_mb: int = 100, pipeline_size: int = 2,
-                 **_compat):
+                 abstract_state: bool = False, **_compat):
         # overlap_*/bucket_cap/pipeline knobs: XLA's latency-hiding scheduler
         # owns these on TPU; accepted for API parity.
         self.mesh = mesh
@@ -204,25 +216,23 @@ class DistributedFusedAdam:
         rep = NamedSharding(mesh, P())
         self._shard, self._rep = shard, rep
 
+        put = _state_put(abstract_state)
+        self.abstract_state = abstract_state
         if store_param_remainders:
             hi, lo = _split_f32(flat_p)
-            self._master_hi = jax.device_put(hi, shard)
-            self._master_lo = jax.device_put(lo, shard)
+            self._master_hi = put(hi, shard)
+            self._master_lo = put(lo, shard)
         else:
-            self._master = jax.device_put(flat_p, shard)
+            self._master = put(flat_p, shard)
         if with_scaled_states:
             nblk = self._n // _SCALE_BLOCK
-            self._m = jax.device_put(
-                jnp.zeros((self._n,), jnp.float16), shard)
-            self._v = jax.device_put(
-                jnp.zeros((self._n,), jnp.float16), shard)
-            self._m_scale = jax.device_put(jnp.ones((nblk,), _f32), shard)
-            self._v_scale = jax.device_put(jnp.ones((nblk,), _f32), shard)
+            self._m = put(jnp.zeros((self._n,), jnp.float16), shard)
+            self._v = put(jnp.zeros((self._n,), jnp.float16), shard)
+            self._m_scale = put(jnp.ones((nblk,), _f32), shard)
+            self._v_scale = put(jnp.ones((nblk,), _f32), shard)
         else:
-            self._m = jax.device_put(
-                jnp.zeros((self._n,), state_dtype), shard)
-            self._v = jax.device_put(
-                jnp.zeros((self._n,), state_dtype), shard)
+            self._m = put(jnp.zeros((self._n,), state_dtype), shard)
+            self._v = put(jnp.zeros((self._n,), state_dtype), shard)
             self._m_scale = self._v_scale = None
         self._params = self._unflatten_groups(flat_p)
         self._step = jnp.zeros((), jnp.int32)
@@ -409,6 +419,7 @@ class DistributedFusedAdam:
         """Add one micro-batch's grads into the sharded accumulation buffer
         (the reference's hook-accumulated main_grad flow). ``step()`` with no
         grads consumes it."""
+        self._check_concrete("accumulate()")
         if self._jit_acc is None:
             def acc_fn(acc, grads, inv_scale):
                 flat = self._flatten_grads(grads).astype(_f32) * inv_scale
@@ -424,8 +435,16 @@ class DistributedFusedAdam:
             self._acc = self._jit_acc(self._acc, grads,
                                       jnp.asarray(inv_scale, _f32))
 
+    def _check_concrete(self, what: str):
+        if self.abstract_state:
+            raise RuntimeError(
+                f"{what} requires runtime state, but this instance was "
+                "built with abstract_state=True (compile-only: state is "
+                "shape structs for AOT lowering, tools/stack_aot.py)")
+
     def step(self, grads: Any = None, lr: Optional[float] = None,
              inv_scale=1.0, found_inf=False):
+        self._check_concrete("step()")
         if self._jit_step is None:
             self._jit_step = self._build_step()
         jit_tree, jit_flat = self._jit_step

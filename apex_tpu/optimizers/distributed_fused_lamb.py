@@ -82,7 +82,7 @@ class DistributedFusedLAMB:
                  axis: str = "data", state_dtype=jnp.float32,
                  clip_after_ar: bool = True, full_ar: bool = False,
                  fused_norm: bool = True, fuse_scale: bool = True,
-                 **_compat):
+                 abstract_state: bool = False, **_compat):
         self.mesh = mesh
         self.axis = axis
         self.lr = lr
@@ -107,9 +107,13 @@ class DistributedFusedLAMB:
         shard = NamedSharding(mesh, P(axis))
         self._shard = shard
         self._rep = NamedSharding(mesh, P())
-        self._master = jax.device_put(flat_p, shard)
-        self._m = jax.device_put(jnp.zeros((self._n,), state_dtype), shard)
-        self._v = jax.device_put(jnp.zeros((self._n,), state_dtype), shard)
+        from apex_tpu.optimizers.distributed_fused_adam import _state_put
+
+        put = _state_put(abstract_state)
+        self.abstract_state = abstract_state
+        self._master = put(flat_p, shard)
+        self._m = put(jnp.zeros((self._n,), state_dtype), shard)
+        self._v = put(jnp.zeros((self._n,), state_dtype), shard)
         self._params = params
         self._step = jnp.zeros((), jnp.int32)
         self._is_accumulation_step = False
@@ -248,6 +252,11 @@ class DistributedFusedLAMB:
 
     def step(self, grads: Any, lr: Optional[float] = None, inv_scale=1.0,
              found_inf=False):
+        if self.abstract_state:
+            raise RuntimeError(
+                "step() requires runtime state, but this instance was "
+                "built with abstract_state=True (compile-only: state is "
+                "shape structs for AOT lowering, tools/stack_aot.py)")
         if self._is_accumulation_step:
             self._accumulate(grads, inv_scale, found_inf)
             return self._params

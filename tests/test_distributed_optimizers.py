@@ -403,6 +403,26 @@ class TestDistributedFusedLAMB:
             for a, b in zip(outs[True], outs[False]))
 
 
+class TestAbstractState:
+    """abstract_state=True builds compile-only instances (state as sharded
+    shape structs, used by tools/stack_aot.py) — runtime entry points must
+    refuse with a clear error instead of failing deep inside jax."""
+
+    def test_state_is_structs_and_step_refuses(self, mesh):
+        a = DistributedFusedAdam(_params(), mesh, lr=1e-3,
+                                 abstract_state=True)
+        assert isinstance(a._master, jax.ShapeDtypeStruct)
+        with pytest.raises(RuntimeError, match="abstract_state"):
+            a.step(_grads(1))
+        with pytest.raises(RuntimeError, match="abstract_state"):
+            a.accumulate(_grads(1))
+        lamb = DistributedFusedLAMB(_params(), mesh, lr=1e-3,
+                                    abstract_state=True)
+        assert isinstance(lamb._master, jax.ShapeDtypeStruct)
+        with pytest.raises(RuntimeError, match="abstract_state"):
+            lamb.step(_grads(1))
+
+
 class TestRedundant2DGrid:
     def test_state_sharded_over_data_replicated_over_redundant(self):
         """The reference's 2D process grid (distributed_fused_adam.py:316-328):
