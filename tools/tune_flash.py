@@ -78,10 +78,14 @@ def run_sweep(jax, jnp, out=sys.stdout):
 
     b, h, s, d = (4, 16, 2048, 64) if on_tpu else (1, 2, 256, 64)
     iters = 20 if on_tpu else 2
+    # (1024,2048)/(2048,1024)/(2048,2048) are excluded: their BACKWARD
+    # exceeds v5e VMEM (proven deviceless — tools/flash_blocks_aot.json,
+    # Mosaic RESOURCE_EXHAUSTED on the dkv transpose scratch); a sweep
+    # winner must be usable for fwd AND bwd since q080 applies it to both
     blocks = ([(256, 256), (256, 512), (512, 512), (512, 1024),
                (1024, 512), (1024, 1024), (2048, 512), (512, 2048),
-               (1024, 2048), (2048, 1024), (2048, 2048), (256, 2048),
-               (128, 1024), (128, 2048), (256, 1024), (128, 512)]
+               (256, 2048), (128, 1024), (128, 2048), (256, 1024),
+               (128, 512)]
               if on_tpu else [(128, 128), (256, 128)])
     best = None
     for bq, bk in blocks:
