@@ -448,7 +448,25 @@ def check_remote_copy(jax, jnp):
     e1, ok1 = _cmp(y, x, 0.0)
     e2, ok2 = _cmp(lo, jnp.zeros_like(lo), 0.0)
     e3, ok3 = _cmp(hi, jnp.zeros_like(hi), 0.0)
-    return {"max_err": max(e1, e2, e3), "pass": ok1 and ok2 and ok3}
+
+    # pool-backed landing buffers: the same exchange with donated
+    # input/output-aliased buffers (PeerMemoryPool flow) must agree —
+    # compiles the aliasing path on the real chip
+    from apex_tpu.ops.pallas.remote_copy import halo_buf_rows
+
+    br = halo_buf_rows(16, 2, x.dtype)
+    bufs = (jnp.zeros((br, 256), x.dtype), jnp.zeros((br, 256), x.dtype))
+
+    def body_pool(x, lo_in, hi_in):
+        return halo_exchange_rdma(x, "x", 2, bufs=(lo_in, hi_in))
+
+    lo2, hi2 = jax.jit(jax.shard_map(
+        body_pool, mesh=mesh, in_specs=(P("x"), P("x"), P("x")),
+        out_specs=(P("x"), P("x")), check_vma=False))(x, *bufs)
+    e4, ok4 = _cmp(lo2, jnp.zeros_like(lo2), 0.0)
+    e5, ok5 = _cmp(hi2, jnp.zeros_like(hi2), 0.0)
+    return {"max_err": max(e1, e2, e3, e4, e5),
+            "pass": ok1 and ok2 and ok3 and ok4 and ok5}
 
 
 CHECKS = [
