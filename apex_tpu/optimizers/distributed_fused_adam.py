@@ -543,6 +543,7 @@ class DistributedFusedAdam:
 
     def state_dict(self, gather_on_root: bool = True):
         """v1 semantics (ref :2907): gather shards → full host arrays."""
+        self._check_concrete("state_dict()")
         m, v = self._state_f32()
         return {
             "step": int(self._step),
@@ -556,6 +557,7 @@ class DistributedFusedAdam:
         """v2 semantics (ref :3059-3329): per-shard state, no gather. Each
         entry maps shard index → host array; ``unpadded`` records the true
         payload so a different world size can re-pad on load."""
+        self._check_concrete("sharded_state_dict()")
         world = self.mesh.shape[self.axis]
         shard_size = self._n // world
 
@@ -581,6 +583,7 @@ class DistributedFusedAdam:
         }
 
     def load_state_dict(self, sd):
+        self._check_concrete("load_state_dict()")
         self._step = jnp.asarray(sd["step"], jnp.int32)
         self.lr = sd.get("lr", self.lr)
         if "world" in sd:  # sharded (v2) checkpoint: concatenate shards

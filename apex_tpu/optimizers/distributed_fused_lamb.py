@@ -250,13 +250,16 @@ class DistributedFusedLAMB:
 
         return jax.jit(step_fn, donate_argnums=(0, 1, 2, 4))
 
-    def step(self, grads: Any, lr: Optional[float] = None, inv_scale=1.0,
-             found_inf=False):
+    def _check_concrete(self, what: str):
         if self.abstract_state:
             raise RuntimeError(
-                "step() requires runtime state, but this instance was "
+                f"{what} requires runtime state, but this instance was "
                 "built with abstract_state=True (compile-only: state is "
                 "shape structs for AOT lowering, tools/stack_aot.py)")
+
+    def step(self, grads: Any, lr: Optional[float] = None, inv_scale=1.0,
+             found_inf=False):
+        self._check_concrete("step()")
         if self._is_accumulation_step:
             self._accumulate(grads, inv_scale, found_inf)
             return self._params
@@ -287,6 +290,7 @@ class DistributedFusedLAMB:
             self._shard)
 
     def state_dict(self):
+        self._check_concrete("state_dict()")
         return {"step": int(self._step), "lr": self.lr,
                 "master": np.asarray(self._master),
                 "m": np.asarray(self._m), "v": np.asarray(self._v),
@@ -294,6 +298,7 @@ class DistributedFusedLAMB:
                         else np.asarray(self._acc))}
 
     def load_state_dict(self, sd):
+        self._check_concrete("load_state_dict()")
         self._step = jnp.asarray(sd["step"], jnp.int32)
         self.lr = sd.get("lr", self.lr)
         self._master = jax.device_put(jnp.asarray(sd["master"]), self._shard)

@@ -416,11 +416,19 @@ class TestAbstractState:
             a.step(_grads(1))
         with pytest.raises(RuntimeError, match="abstract_state"):
             a.accumulate(_grads(1))
+        for fn in (a.state_dict, a.sharded_state_dict,
+                   lambda: a.load_state_dict({})):
+            with pytest.raises(RuntimeError, match="abstract_state"):
+                fn()
         lamb = DistributedFusedLAMB(_params(), mesh, lr=1e-3,
                                     abstract_state=True)
         assert isinstance(lamb._master, jax.ShapeDtypeStruct)
         with pytest.raises(RuntimeError, match="abstract_state"):
             lamb.step(_grads(1))
+        with pytest.raises(RuntimeError, match="abstract_state"):
+            lamb.state_dict()
+        with pytest.raises(RuntimeError, match="abstract_state"):
+            lamb.load_state_dict({})
 
 
 class TestRedundant2DGrid:

@@ -46,7 +46,11 @@ present = [a for a in ARTIFACTS if os.path.exists(os.path.join(ROOT, a))]
 if not present:
     raise AssertionError("no artifacts to commit yet")
 subprocess.run(["git", "add", "--"] + present, cwd=ROOT, check=True)
-diff = subprocess.run(["git", "diff", "--cached", "--name-only"],
+# restrict BOTH the staged listing and the commit to the artifact
+# pathspec: anything else sitting in the shared index (e.g. a q080 source
+# patch whose gated commit failed midway) must never ride along
+diff = subprocess.run(["git", "diff", "--cached", "--name-only", "--"]
+                      + present,
                       cwd=ROOT, capture_output=True, text=True, check=True)
 staged = [ln for ln in diff.stdout.splitlines() if ln.strip()]
 if staged:
@@ -63,7 +67,7 @@ if staged:
     subprocess.run(
         ["git", "commit", "-q", "-m",
          f"On-chip artifacts from the background queue{head}",
-         "-m", "Files: " + ", ".join(staged)],
+         "-m", "Files: " + ", ".join(staged), "--"] + staged,
         cwd=ROOT, check=True)
 print(json.dumps({"committed": staged,
                   "t": time.strftime("%Y-%m-%dT%H:%M:%S")}))
