@@ -163,6 +163,47 @@ def compile_ulysses(mesh4):
     return fn.lower(q, q, q).compile()
 
 
+def compile_ring_long(mesh16, zigzag: bool):
+    """Long-context story at real scale: 131k tokens of causal ring /
+    zigzag attention sharded over a 16-chip, 4-HOST v5e:4x4 topology —
+    the multi-host partitioning path the reference reaches with NCCL."""
+    from apex_tpu.parallel.ring_attention import (
+        ring_attention, zigzag_ring_self_attention)
+
+    n = mesh16.shape["sp"]
+    s_total = n * 8192  # 131072 tokens over 16 chips
+    ns = NamedSharding(mesh16, P(None, None, "sp", None))
+    q = jax.ShapeDtypeStruct((1, 8, s_total, 128), jnp.bfloat16,
+                             sharding=ns)
+    if zigzag:
+        body = lambda q, k, v: zigzag_ring_self_attention(  # noqa: E731
+            q, k, v, "sp")
+    else:
+        body = lambda q, k, v: ring_attention(  # noqa: E731
+            q, k, v, axis_name="sp", causal=True)
+    fn = jax.jit(jax.shard_map(
+        body, mesh=mesh16, in_specs=P(None, None, "sp", None),
+        out_specs=P(None, None, "sp", None), check_vma=False))
+    return fn.lower(q, q, q).compile()
+
+
+def compile_zero_adam_16dev(mesh16d):
+    """ZeRO-2 Adam sharded over 16 chips / 4 hosts at 64M params."""
+    from apex_tpu.optimizers.distributed_fused_adam import \
+        DistributedFusedAdam
+
+    params = [jnp.zeros((8192, 4096), jnp.float32),
+              jnp.zeros((8192 * 4096,), jnp.float32)]
+    dopt = DistributedFusedAdam(params, mesh16d, lr=1e-3,
+                                store_param_remainders=True,
+                                abstract_state=True)
+    jit_tree, _ = dopt._build_step()
+    grads = _gstructs(params)
+    vecs = dopt._group_vectors(1e-3)
+    return jit_tree.lower(dopt._state_pack(), grads, jnp.int32(1),
+                          _f32(1.0), jnp.asarray(False), *vecs).compile()
+
+
 def main():
     t0 = time.time()
     topo = topologies.get_topology_desc(
@@ -175,6 +216,11 @@ def main():
     mesh_tp_sp = make_mesh([1, 2, 2], ["dp", "tp", "sp"], list(devs))
     mesh5 = make_mesh([1, 2, 2, 1, 1], ["dp", "pp", "tp", "sp", "ep"],
                       list(devs))
+    # 16-chip, 4-HOST topology for the long-context / ZeRO-at-scale cases
+    topo16 = topologies.get_topology_desc("v5e:4x4", "tpu")
+    devs16 = np.array(topo16.devices)
+    mesh16_sp = Mesh(devs16.reshape(16), ("sp",))
+    mesh16_d = Mesh(devs16.reshape(16), ("data",))
 
     CASES = [
         ("dist_adam_base", lambda: compile_dist_adam(mesh_data)),
@@ -196,6 +242,12 @@ def main():
         ("gpt2_pp2_tp2_moe_train", lambda: compile_gpt2_pp_tp(mesh5)),
         ("ddp_syncbn_4dev", lambda: compile_ddp_syncbn(mesh_data)),
         ("ulysses_attention_4dev", lambda: compile_ulysses(mesh_data)),
+        ("ring_attention_131k_16dev_4host",
+         lambda: compile_ring_long(mesh16_sp, zigzag=False)),
+        ("zigzag_attention_131k_16dev_4host",
+         lambda: compile_ring_long(mesh16_sp, zigzag=True)),
+        ("zero_adam_64m_16dev_4host",
+         lambda: compile_zero_adam_16dev(mesh16_d)),
     ]
 
     result = {"device_kind": getattr(topo.devices[0], "device_kind", "?"),
