@@ -18,7 +18,10 @@ if jax.default_backend() != "tpu" and \
 sys.path.insert(0, os.path.join(ROOT, "tools"))
 import tune_flash  # noqa: E402
 
-with open(os.path.join(ROOT, "tools", "tune_flash.out"), "a") as f:
+# CPU (smoke) runs must never pollute the real sweep file q080 reads
+name = ("tune_flash.out" if jax.default_backend() == "tpu"
+        else "tune_flash_smoke.out")
+with open(os.path.join(ROOT, "tools", name), "a") as f:
     best = tune_flash.run_sweep(jax, jnp, out=f)
 if jax.default_backend() != "tpu":
     raise AssertionError("sweep ran on CPU")

@@ -34,7 +34,10 @@ n = 999_999_488 if ON_TPU else 1_048_576
 rows = n // LANE
 floor_s = measure_fetch_floor()
 
-out_path = os.path.join(ROOT, "tools", "tune_adam.out")
+# CPU (smoke) runs must never pollute the real sweep file q085 reads
+out_path = os.path.join(ROOT, "tools",
+                        "tune_adam.out" if ON_TPU
+                        else "tune_adam_smoke.out")
 best = None
 with open(out_path, "a") as out:
     print(f"# backend={jax.default_backend()} n={n}", file=out, flush=True)
@@ -69,6 +72,8 @@ with open(out_path, "a") as out:
                   file=out, flush=True)
         finally:
             del p, g, m, v
-    print(json.dumps({"best": best}), file=out, flush=True)
+    print(json.dumps({"best": best,
+                      "backend": jax.default_backend()}),
+          file=out, flush=True)
 if best is None:
     raise AssertionError("no successful config")

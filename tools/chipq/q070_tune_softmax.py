@@ -57,7 +57,8 @@ def run_variant(chunked: bool):
 
 
 results = []
-with open(os.path.join(ROOT, "tools", "tune_softmax.out"), "a") as out:
+_oname = ("tune_softmax.out" if ON_TPU else "tune_softmax_smoke.out")
+with open(os.path.join(ROOT, "tools", _oname), "a") as out:
     print(f"# backend={jax.default_backend()} b{b}h{h}s{s}", file=out,
           flush=True)
     for name, chunked in [("chunked", True), ("row_complete", False)]:

@@ -41,9 +41,13 @@ with open(sweep_path) as f:
             except ValueError:
                 continue
             if isinstance(rec.get("best"), dict):
-                best = rec["best"]
+                # apply only TPU-measured bests; records without a
+                # backend stamp predate it and are known-TPU (the smoke
+                # path writes tune_flash_smoke.out since round 5)
+                if rec.get("backend", "tpu") == "tpu":
+                    best = rec["best"]
 if best is None or "bq" not in best:
-    raise AssertionError("no best config in tune_flash.out yet")
+    raise AssertionError("no TPU best config in tune_flash.out yet")
 bq, bk = int(best["bq"]), int(best["bk"])
 
 kpath = os.path.join(ROOT, "apex_tpu", "ops", "pallas",

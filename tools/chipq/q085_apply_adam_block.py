@@ -38,11 +38,15 @@ with open(os.path.join(ROOT, "tools", "tune_adam.out")) as f:
             except ValueError:
                 continue
             if isinstance(rec.get("best"), dict):
-                best = rec["best"]
+                # apply only TPU-measured bests (smoke runs write to
+                # tune_adam_smoke.out since round 5; unstamped records
+                # predate the stamp and are known-TPU)
+                if rec.get("backend", "tpu") == "tpu":
+                    best = rec["best"]
             elif "block_rows" in rec and "hbm_frac" in rec:
                 rows[rec["block_rows"]] = rec["hbm_frac"]
 if best is None:
-    raise AssertionError("no best config in tune_adam.out yet")
+    raise AssertionError("no TPU best config in tune_adam.out yet")
 
 kpath = os.path.join(ROOT, "apex_tpu", "ops", "pallas",
                      "fused_adam_kernel.py")

@@ -126,7 +126,9 @@ def run_sweep(jax, jnp, out=sys.stdout):
     except Exception as e:
         emit({"comparator": "jax pallas flash",
               "error": f"{type(e).__name__}: {e}"})
-    emit({"best": best})
+    # stamp the backend into the best record: q080 must never apply block
+    # defaults derived from a CPU (interpret-mode) sweep line
+    emit({"best": best, "backend": backend})
     return best
 
 
