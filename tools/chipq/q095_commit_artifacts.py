@@ -10,8 +10,10 @@ import subprocess
 import sys
 import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+# APEX_TPU_ROOT lets the queue dry-run execute COPIES of these jobs from
+# a throwaway dir while still resolving repo artifacts correctly
+ROOT = os.environ.get("APEX_TPU_ROOT") or os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 if ROOT not in sys.path:
     sys.path.insert(0, ROOT)
 
