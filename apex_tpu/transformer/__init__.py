@@ -20,6 +20,9 @@ from apex_tpu.transformer.fused_dense import (  # noqa: F401
     dense_gelu_dense,
     linear_bias,
 )
+from apex_tpu.transformer.linear_cross_entropy import (  # noqa: F401
+    linear_cross_entropy,
+)
 from apex_tpu.transformer.mlp import MLP, mlp_forward  # noqa: F401
 from apex_tpu.transformer.wgrad import (  # noqa: F401
     wgrad_gemm_accum_fp16,

@@ -1,0 +1,150 @@
+"""Chunked-vocab fused linear + cross-entropy head (beyond-reference).
+
+The reference fuses softmax+CE once logits exist (``xentropy_cuda``,
+apex/contrib/csrc/xentropy/ — see ``contrib/xentropy.py`` for that
+surface). At LM scale the dominant cost is upstream of that: the logits
+matrix itself. GPT-2-xl at b4·s512 holds (2048, 50257) logits — ~400 MB
+of fp32 activations plus the same again for autodiff residuals — whose
+only purpose is one lse and one gathered label logit per row.
+
+``linear_cross_entropy(hidden, weight, labels)`` computes the LM-head
+matmul and the (label-smoothed) cross entropy TOGETHER, scanning the
+vocabulary in chunks with an online logsumexp, so the full logits matrix
+NEVER exists in HBM — per-chunk (N, C) tiles live transiently and XLA
+fuses each chunk's matmul+softmax pipeline. The custom VJP saves only
+``(hidden, weight, lse)`` — one fp32 scalar per row, the same residual
+discipline as the reference's xentropy (interface.cpp:42-45) — and the
+backward re-scans the chunks, rebuilding each logits tile once
+(rematerialization: trade MXU FLOPs for HBM capacity, the right trade on
+TPU).
+
+TPU-first notes: chunk width defaults to 8192 lanes (64 MXU tiles); the
+vocab tail is padded to the chunk grid with columns masked to -inf so
+the lse is exact; everything is ``lax.scan`` — one trace, static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_f32 = jnp.float32
+_NEG = -1e30
+
+
+def _pad_weight(weight, chunk):
+    h, v = weight.shape
+    vpad = -(-v // chunk) * chunk
+    if vpad != v:
+        weight = jnp.pad(weight, ((0, 0), (0, vpad - v)))
+    return weight, vpad
+
+
+def _chunk_logits(hidden, wc, c0, chunk, v, logit_scale):
+    """One chunk's logits tile (N, C) in fp32, tail columns masked."""
+    x = (hidden @ wc).astype(_f32) * logit_scale
+    col = c0 + jax.lax.iota(jnp.int32, chunk)[None, :]
+    return jnp.where(col < v, x, _NEG), col
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def linear_cross_entropy(hidden: jax.Array, weight: jax.Array,
+                         labels: jax.Array, smoothing: float = 0.0,
+                         padding_idx: Optional[int] = None,
+                         chunk: int = 8192, logit_scale: float = 1.0):
+    """Per-row loss of ``softmax_cross_entropy(hidden @ weight, labels)``
+    without materializing the logits. hidden: (N, H); weight: (H, V);
+    labels: (N,) int32. Returns (N,) fp32 — semantics identical to
+    ``contrib.xentropy.softmax_cross_entropy_loss`` on the dense logits
+    (label smoothing ε, ``padding_idx`` rows contribute zero loss/grad).
+    """
+    loss, _ = _lce_fwd_math(hidden, weight, labels, smoothing, padding_idx,
+                            chunk, logit_scale)
+    return loss
+
+
+def _lce_fwd_math(hidden, weight, labels, smoothing, padding_idx, chunk,
+                  logit_scale):
+    n, h = hidden.shape
+    v = weight.shape[1]
+    wp, vpad = _pad_weight(weight, chunk)
+    nchunks = vpad // chunk
+
+    def body(carry, idx):
+        m, s, picked, xsum = carry
+        # slice the chunk in place — scanning over a pre-stacked
+        # (nc, H, C) moveaxis copy would hold a second full weight in HBM
+        wc = jax.lax.dynamic_slice(wp, (0, idx * chunk), (h, chunk))
+        logits, col = _chunk_logits(hidden, wc, idx * chunk, chunk, v,
+                                    logit_scale)
+        cm = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cm)
+        # rescale the running sum-exp to the new max
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        hit = col == labels[:, None]
+        picked = picked + jnp.sum(jnp.where(hit, logits, 0.0), axis=-1)
+        xsum = xsum + jnp.sum(jnp.where(col < v, logits, 0.0), axis=-1)
+        return (m_new, s, picked, xsum), None
+
+    init = (jnp.full((n,), _NEG, _f32), jnp.zeros((n,), _f32),
+            jnp.zeros((n,), _f32), jnp.zeros((n,), _f32))
+    (m, s, picked, xsum), _ = jax.lax.scan(
+        body, init, jnp.arange(nchunks))
+    lse = jnp.log(s) + m
+    nll = lse - picked
+    if smoothing > 0.0:
+        loss = (1.0 - smoothing) * nll + smoothing * (lse - xsum / v)
+    else:
+        loss = nll
+    if padding_idx is not None:
+        loss = jnp.where(labels == padding_idx, 0.0, loss)
+    return loss, lse
+
+
+def _lce_vjp_fwd(hidden, weight, labels, smoothing, padding_idx, chunk,
+                 logit_scale):
+    loss, lse = _lce_fwd_math(hidden, weight, labels, smoothing,
+                              padding_idx, chunk, logit_scale)
+    # residuals: inputs + one fp32 scalar per row — never the logits
+    return loss, (hidden, weight, labels, lse)
+
+
+def _lce_vjp_bwd(smoothing, padding_idx, chunk, logit_scale, res, dloss):
+    hidden, weight, labels, lse = res
+    n, h = hidden.shape
+    v = weight.shape[1]
+    wp, vpad = _pad_weight(weight, chunk)
+    nchunks = vpad // chunk
+
+    g = dloss.astype(_f32)
+    if padding_idx is not None:
+        g = jnp.where(labels == padding_idx, 0.0, g)
+
+    def body(dh, idx):
+        wc = jax.lax.dynamic_slice(wp, (0, idx * chunk), (h, chunk))
+        logits, col = _chunk_logits(hidden, wc, idx * chunk, chunk, v,
+                                    logit_scale)
+        p = jnp.exp(logits - lse[:, None])           # softmax tile
+        onehot = (col == labels[:, None]).astype(_f32)
+        target = (1.0 - smoothing) * onehot
+        if smoothing > 0.0:
+            target = target + jnp.where(col < v, smoothing / v, 0.0)
+        dl = (p - target) * g[:, None] * logit_scale  # dlogits tile (N, C)
+        # bf16 operands on the MXU, fp32 accumulation (input-dtype matmul
+        # rule — see docs/performance.md kernel design notes)
+        dl = dl.astype(hidden.dtype)
+        dh = dh + jnp.dot(dl, wc.T, preferred_element_type=_f32)
+        dwc = jnp.dot(hidden.T, dl, preferred_element_type=_f32)
+        return dh, dwc.astype(weight.dtype)
+
+    dh0 = jnp.zeros((n, h), _f32)
+    dh, dwcs = jax.lax.scan(body, dh0, jnp.arange(nchunks))
+    dw = jnp.moveaxis(dwcs, 0, 1).reshape(h, vpad)[:, :v]
+    return dh.astype(hidden.dtype), dw.astype(weight.dtype), None
+
+
+linear_cross_entropy.defvjp(_lce_vjp_fwd, _lce_vjp_bwd)

@@ -33,6 +33,12 @@ os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+try:  # persistent cache: deviceless AOT compiles are cache-keyed, so
+    # re-runs (tests, artifact refreshes) skip recompilation
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+except Exception:
+    pass
 
 import jax.numpy as jnp  # noqa: E402
 from jax.experimental import topologies  # noqa: E402
@@ -142,9 +148,64 @@ def case_gpt2_fwd(s):
     return step, (params, tokens)
 
 
+def case_lm_head_fused(s):
+    """Chunked-vocab fused linear+CE at long-batch LM-head scale
+    (N=16384 ≈ b8·s2048, H=1600, V=50257), fwd+bwd — the
+    full-logits-free training head. The dense baseline below materializes
+    the (16384, 50257) logits (bf16 after XLA fuses the fp32 cast,
+    ~1.65 GB of temp) where this case streams vocab chunks."""
+    from apex_tpu.transformer import linear_cross_entropy
+
+    n, h, v = 16384, 1600, 50257
+    hd = jax.ShapeDtypeStruct((n, h), jnp.bfloat16, sharding=s)
+    w = jax.ShapeDtypeStruct((h, v), jnp.bfloat16, sharding=s)
+    lb = jax.ShapeDtypeStruct((n,), jnp.int32, sharding=s)
+
+    def step(hd, w, lb):
+        return jax.grad(
+            lambda hd, w: jnp.mean(linear_cross_entropy(hd, w, lb)),
+            argnums=(0, 1))(hd, w)
+
+    return step, (hd, w, lb)
+
+
+def case_lm_head_dense(s):
+    """Same computation via materialized logits + contrib.xentropy — the
+    memory baseline the fused head exists to beat."""
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
+
+    n, h, v = 16384, 1600, 50257
+    hd = jax.ShapeDtypeStruct((n, h), jnp.bfloat16, sharding=s)
+    w = jax.ShapeDtypeStruct((h, v), jnp.bfloat16, sharding=s)
+    lb = jax.ShapeDtypeStruct((n,), jnp.int32, sharding=s)
+
+    def step(hd, w, lb):
+        def loss(hd, w):
+            logits = (hd @ w).astype(jnp.float32)
+            return jnp.mean(softmax_cross_entropy_loss(logits, lb))
+
+        return jax.grad(loss, argnums=(0, 1))(hd, w)
+
+    return step, (hd, w, lb)
+
+
 CASES = [("resnet50_b128_train", case_resnet50),
          ("bert_large_b32_lamb_train", case_bert_lamb),
-         ("gpt2_xl_b4_s512_fwd", case_gpt2_fwd)]
+         ("gpt2_xl_b4_s512_fwd", case_gpt2_fwd),
+         ("lm_head_fused_linear_ce", case_lm_head_fused),
+         ("lm_head_dense_baseline", case_lm_head_dense)]
+
+# honesty notes stamped into the artifact: XLA cost_analysis counts a
+# lax.scan (while-loop) body ONCE, so scan-based cases' flops/t_mxu_ms
+# understate true per-step cost by the trip count
+NOTES = {
+    "lm_head_fused_linear_ce":
+        "cost_analysis counts the vocab scan body once: true per-step "
+        "flops ~= reported x7 trips (~1.2e13, ~30 ms MXU) - by design "
+        "the fused head trades MXU flops (logits rematerialized in bwd) "
+        "for HBM capacity; hbm_total_bytes is the honest comparison "
+        "field vs lm_head_dense_baseline",
+}
 
 
 def main():
@@ -193,6 +254,8 @@ def main():
             entry = {"ok": False,
                      "error": f"{type(e).__name__}: {str(e)[:1500]}"}
         entry["wall_s"] = round(time.time() - t1, 1)
+        if name in NOTES:
+            entry["cost_note"] = NOTES[name]
         ok_all = ok_all and entry["ok"]
         result["models"][name] = entry
         print(f"[model_aot] {name} "

@@ -35,6 +35,12 @@ os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+try:  # persistent cache: deviceless AOT compiles are cache-keyed, so
+    # re-runs (tests, artifact refreshes) skip recompilation
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(ROOT, ".jax_cache"))
+except Exception:
+    pass
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
