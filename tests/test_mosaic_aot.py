@@ -39,3 +39,16 @@ def test_kernel_zoo_compiles_for_v5e(tmp_path):
     # the multi-device RDMA ring and ring attention must be among them
     assert "remote_copy" in art["kernels"]
     assert "ring_attention" in art["kernels"]
+    # memory-structure regressions the compile-only client can prove:
+    # flash attention must stay O(s·d), far under the ~1.07 GB a
+    # materialized (b4·h16) 2048x2048 fp32 score matrix would need
+    fa = art["kernels"]["flash_attention"]["tags"]
+    for tag in ("causal_fwd_b4h16s2048", "dropout_fwd"):
+        tmp = fa[tag].get("hbm_tmp_bytes")
+        if tmp is not None:
+            assert tmp < 400e6, (tag, tmp)
+    # the flat Adam kernel streams fully in place: zero temp HBM
+    ad = art["kernels"]["fused_adam_flat"]["tags"]
+    for tag, e in ad.items():
+        if e.get("hbm_tmp_bytes") is not None:
+            assert e["hbm_tmp_bytes"] < 1e6, (tag, e)
