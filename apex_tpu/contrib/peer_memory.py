@@ -13,9 +13,15 @@ device allocation up front (the analog of ``pm.allocate_raw``,
 peer_memory.py:31), 256-byte-aligned static/dynamic bump sub-allocation
 with the reference's exhaustion asserts, and per-peer views that are
 genuine device arrays. Pool buffers plug into the RDMA halo exchange as
-DONATED landing buffers (``halo_exchange_rdma(..., bufs=...)``), giving
-the reference pool's actual purpose: remote puts land in preallocated
-storage, no fresh HBM allocation per iteration.
+DONATED landing buffers: thread them through ``shard_map`` as ARGUMENTS
+and call ``halo_exchange_rdma(..., bufs=..., return_bufs=True)`` — the
+remote puts land in their storage via input/output aliasing, and
+re-threading the returned buffers into the next step keeps iteration
+allocation-free (the reference pool's purpose). The threading must be
+explicit and functional: buffers closed over inside a trace would be
+baked in as constants, and re-materializing arena views per call would
+allocate fresh storage — both defeat the point, so the exchanger facade
+does not do it implicitly.
 
 ``transport="rdma"`` routes the exchange through an explicit Pallas
 one-sided remote DMA (``ops/pallas/remote_copy.halo_exchange_rdma``) —
@@ -162,21 +168,6 @@ class PeerHaloExchanger1d:
         self.half_halo = half_halo
         self.transport = transport
         self.peer_pool = peer_pool
-        self._pool_bufs: dict = {}  # (shape, dtype) -> (idx_lo, idx_hi)
-
-    def _landing_bufs(self, strip_shape, dtype, halo):
-        """RDMA landing buffers from the peer pool (allocated once per
-        shape/dtype, views re-materialized after donation)."""
-        if self.peer_pool is None:
-            return None
-        key = (tuple(strip_shape), jnp.dtype(dtype).name)
-        if key not in self._pool_bufs:
-            lo, hi, idxs = self.peer_pool.allocate_halo_buffers(
-                strip_shape, halo, dtype)
-            self._pool_bufs[key] = idxs
-            return lo, hi
-        idx_lo, idx_hi = self._pool_bufs[key]
-        return self.peer_pool.view(idx_lo), self.peer_pool.view(idx_hi)
 
     def left_right_halo_exchange(self, left_output_halo, right_output_halo):
         if self.transport == "rdma":
@@ -191,8 +182,7 @@ class PeerHaloExchanger1d:
                     f"{h} vs {right_output_halo.shape[0]} rows — use "
                     "transport='collective' for asymmetric strips")
             both = jnp.concatenate([left_output_halo, right_output_halo], 0)
-            bufs = self._landing_bufs(both.shape, both.dtype, h)
-            lo, hi = halo_exchange_rdma(both, self.axis_name, h, bufs=bufs)
+            lo, hi = halo_exchange_rdma(both, self.axis_name, h)
             return lo, hi
         return left_right_halo_exchange(left_output_halo, right_output_halo,
                                         self.axis_name)
@@ -211,8 +201,7 @@ class PeerHaloExchanger1d:
                                           axis=spatial_axis)
             both = jnp.concatenate([top, bottom], axis=spatial_axis)
             both = jnp.moveaxis(both, spatial_axis, 0)
-            bufs = self._landing_bufs(both.shape, both.dtype, h)
-            lo, hi = halo_exchange_rdma(both, self.axis_name, h, bufs=bufs)
+            lo, hi = halo_exchange_rdma(both, self.axis_name, h)
             lo = jnp.moveaxis(lo, 0, spatial_axis)
             hi = jnp.moveaxis(hi, 0, spatial_axis)
             return jnp.concatenate([lo, x, hi], axis=spatial_axis)

@@ -124,7 +124,7 @@ def _halo_kernel(x_ref, lo_ref, hi_ref, slo, shi, rlo, rhi, *,
 
 def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
                        periodic: bool = False,
-                       bufs=None,
+                       bufs=None, return_bufs: bool = False,
                        interpret: bool | None = None):
     """1-D halo exchange over leading axis via peer RDMA puts: returns
     ``(lo, hi)`` — the ``halo`` rows received from the left and right
@@ -136,9 +136,13 @@ def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
     ``bufs=(lo_buf, hi_buf)`` — optional pre-allocated landing buffers of
     shape ``(halo_buf_rows(rows, halo, dtype),) + x.shape[1:]`` (e.g. from
     a PeerMemoryPool arena). They are DONATED: the remote puts land in
-    their storage via input/output aliasing instead of fresh HBM each call
-    — the reference peer pool's no-per-iteration-allocation property
-    (peer_memory.py:29-42)."""
+    their storage via input/output aliasing instead of fresh HBM each
+    call. ``return_bufs=True`` additionally returns the landed full
+    buffers ``(lo_buf', hi_buf')`` so the caller can thread them into the
+    next call (functional buffer reuse — the reference peer pool's
+    no-per-iteration-allocation property, peer_memory.py:29-42, requires
+    this threading; re-materializing views from the arena each call would
+    allocate fresh storage and defeat the point)."""
     if interpret is None:
         interpret = interpret_default()
     rows = x.shape[0]
@@ -190,9 +194,13 @@ def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
     # neighbor's LAST rows / right neighbor's FIRST rows
     lo = jax.lax.slice_in_dim(lo_buf, buf_rows - halo, buf_rows, axis=0)
     hi = jax.lax.slice_in_dim(hi_buf, 0, halo, axis=0)
+    if return_bufs:
+        out_bufs = (lo_buf, hi_buf)
     if not periodic:
         idx = jax.lax.axis_index(axis_name)
         n = jax.lax.axis_size(axis_name)
         lo = jnp.where(idx == 0, jnp.zeros_like(lo), lo)
         hi = jnp.where(idx == n - 1, jnp.zeros_like(hi), hi)
+    if return_bufs:
+        return lo, hi, out_bufs
     return lo, hi

@@ -36,16 +36,19 @@ Clip point (reference :818/:944 vs :976-996, selected by ``clip_after_ar``):
 - ``clip_after_ar=True`` (default): one global L2 norm of the synced flat
   gradient, clip by ``max_grad_norm`` — the reference's post-all-reduce
   clip (:944-975, kernel-side via ``max_grad_norm * clip_after_ar`` :1073).
-- ``clip_after_ar=False``: the reference clips each rank's gradient by a
-  norm computed BEFORE the sync (:981-996) so the clip coefficient never
-  waits on a collective. Under GSPMD the pre-sync view of the flat buffer
-  is the device's own 1-D shard, so the TPU translation clips each flat
-  SHARD by its own local norm — ``coeff_i = min(1, max_grad_norm /
-  (1e-6 + ||g_shard_i||))`` computed shard-locally (the (world, n/world)
-  reshape aligns rows with the P(axis) shards; XLA lowers the row norms
-  collective-free, the property this mode exists for). Like the
-  reference's, this clip is per-device-inconsistent by design — numerics
-  tests pin both points.
+- ``clip_after_ar=False``: the reference clips each rank's gradient by
+  ONE coefficient from a norm computed BEFORE the sync (:981-996) so the
+  clip never waits on a collective. Two TPU realizations, by grad-sync
+  mode:
+  - ``full_ar=True``: grads are replicated, so the reference's exact
+    semantics (one uniform coefficient from the device-local
+    full-gradient norm) is free — local math over replicated data.
+  - ``full_ar=False`` (RS+AR): the pre-sync view is the device's 1-D
+    flat shard; each shard is clipped by its own shard-local norm,
+    keeping the coefficient collective-free. This is a documented
+    TRANSLATION (per-shard coefficients depend on flat-shard boundaries
+    and world size), not numerics parity — numerics tests pin all three
+    behaviors.
 - ``fused_norm`` (:119,:176) only applies when clipping pre-AR (the norm
   fuses into the scale pass); here the local-shard norm IS emitted inside
   the single jitted step (XLA fuses it), so the kwarg selects dispatched
@@ -187,11 +190,28 @@ class DistributedFusedLAMB:
                 clip = (jnp.maximum(gnorm / max_gn, 1.0) if max_gn
                         else _f32(1.0))
                 g32 = g32 / clip
+            elif self.full_ar:
+                # pre-AR clip, full-AR mode: every device already holds
+                # the FULL gradient (replicated constraint), so the
+                # reference's exact semantics — ONE coefficient from the
+                # device-local full-gradient norm (:983-996), applied
+                # uniformly — costs no collective here: the norm is local
+                # math over replicated data (fused_norm dispatched)
+                gnorm = jnp.sqrt(jnp.sum(g32 * g32))
+                coeff = jnp.minimum(max_gn / (1e-6 + gnorm), 1.0)
+                g32 = g32 * coeff
             else:
-                # pre-AR clip (reference :981-996): each device clips its
-                # own flat shard by the shard-local norm — the (world, ·)
-                # rows coincide with the P(axis) shards, so no collective
-                # feeds the clip coefficient (fused_norm dispatched)
+                # pre-AR clip, sharded (RS+AR) mode: the pre-sync view of
+                # the flat buffer is the device's own 1-D shard, so each
+                # shard is clipped by its shard-local norm — the (world,·)
+                # rows coincide with the P(axis) shards, keeping the clip
+                # coefficient collective-free (the property this mode
+                # exists for). NOTE this is a deliberate TRANSLATION, not
+                # numerics parity: the reference clips with one uniform
+                # coefficient per rank, so here the clipped gradient
+                # depends on flat-shard boundaries (and hence world size);
+                # use full_ar=True with clip_after_ar=False for the
+                # reference-exact pre-AR coefficient.
                 gsh = jax.lax.with_sharding_constraint(
                     g32.reshape(world, n // world), row_s)
                 local = jnp.sqrt(jnp.sum(gsh * gsh, axis=1, keepdims=True))

@@ -383,6 +383,28 @@ class TestDistributedFusedLAMB:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5, rtol=1e-5)
 
+    def test_clip_before_ar_full_ar_uses_uniform_coeff(self, mesh):
+        """clip_after_ar=False + full_ar=True: grads are replicated, so
+        the reference's EXACT pre-AR semantics — one uniform coefficient
+        from the full-gradient norm (:983-996, coeff = min(1,
+        max_gn/(1e-6+||g||))) — applies, collective-free."""
+        g = self._shard_spanning(5, scales=(4.0, 0.02, 2.0))
+        gnorm = np.sqrt(sum(float(np.sum(np.square(np.asarray(x))))
+                            for x in g))
+        assert gnorm > 1.0
+        dopt = DistributedFusedLAMB(self._shard_spanning(0), mesh,
+                                    lr=1e-2, weight_decay=0.01,
+                                    max_grad_norm=1.0,
+                                    clip_after_ar=False, full_ar=True)
+        dopt.step(g)
+        coeff = min(1.0, 1.0 / (1e-6 + gnorm))
+        ref = DistributedFusedLAMB(self._shard_spanning(0), mesh, lr=1e-2,
+                                   weight_decay=0.01, max_grad_norm=0.0)
+        ref.step([coeff * x for x in g])
+        for a, b in zip(dopt.parameters, ref.parameters):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
     def test_clip_points_differ_when_energy_is_concentrated(self, mesh):
         """A gradient whose energy sits in one flat shard must clip
         DIFFERENTLY at the two clip points (the reference's pre-AR clip is

@@ -336,32 +336,53 @@ class TestRdmaTransport:
         np.testing.assert_array_equal(outs["collective"][1], outs["rdma"][1])
 
     def test_halo_exchange_with_pool_landing_bufs(self, mesh):
-        """A PeerMemoryPool-backed exchanger (remote puts land in arena
-        storage via input/output aliasing) must match the pool-less rdma
-        path, including across repeated calls (view re-materialization
-        after donation)."""
-        from apex_tpu.contrib.peer_memory import (PeerHaloExchanger1d,
-                                                  PeerMemoryPool)
-        x = jnp.arange(WORLD * 8 * 3, dtype=jnp.float32).reshape(
-            1, WORLD * 8, 3)
-        pool = PeerMemoryPool(static_size=1 << 16)
-        ex_pool = PeerHaloExchanger1d(half_halo=2, axis_name="sp",
-                                      transport="rdma", peer_pool=pool)
-        ex_plain = PeerHaloExchanger1d(half_halo=2, axis_name="sp",
-                                       transport="rdma")
-        outs = {}
-        for name, ex in (("pool", ex_pool), ("plain", ex_plain)):
+        """Pool-backed landing buffers, threaded the honest way: arena
+        views enter shard_map as ARGUMENTS, the puts land in their
+        storage via input/output aliasing, and the returned landed
+        buffers re-thread into the next call (allocation-free steady
+        state). Halos must match the pool-less rdma path both calls."""
+        from apex_tpu.contrib.peer_memory import PeerMemoryPool
+        from apex_tpu.ops.pallas.remote_copy import (halo_buf_rows,
+                                                     halo_exchange_rdma)
 
-            @functools.partial(shard_map, mesh=mesh, in_specs=P(None, "sp"),
-                               out_specs=P(None, "sp"), check_vma=False)
-            def body(x, ex=ex):
-                return ex(x, spatial_axis=1)
+        halo = 2
+        rows_per_dev = 8
+        x = jnp.arange(WORLD * rows_per_dev * 128,
+                       dtype=jnp.float32).reshape(WORLD * rows_per_dev, 128)
+        br = halo_buf_rows(rows_per_dev, halo, jnp.float32)
 
-            outs[name] = np.asarray(body(x))
-            # second call re-materializes the pool views post-donation
-            np.testing.assert_array_equal(np.asarray(body(x)), outs[name])
-        np.testing.assert_array_equal(outs["pool"], outs["plain"])
-        # the exchange sub-allocated real arena ranges
+        pool = PeerMemoryPool(static_size=1 << 20)
+        # one buffer pair per device slot, entering shard_map sharded so
+        # each device's slice is the kernel's (br, 128) landing contract
+        lo_b = pool.allocate_peer_tensors((WORLD * br, 128), jnp.float32,
+                                          False, False)[0]
+        hi_b = pool.allocate_peer_tensors((WORLD * br, 128), jnp.float32,
+                                          False, False)[0]
+
+        @functools.partial(shard_map, mesh=mesh,
+                           in_specs=(P("sp"), P("sp"), P("sp")),
+                           out_specs=(P("sp"), P("sp"), (P("sp"), P("sp"))),
+                           check_vma=False)
+        def body(x, lo_in, hi_in):
+            lo, hi, landed = halo_exchange_rdma(x, "sp", halo,
+                                                bufs=(lo_in, hi_in),
+                                                return_bufs=True)
+            return lo, hi, landed
+
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("sp"),
+                           out_specs=(P("sp"), P("sp")), check_vma=False)
+        def plain(x):
+            return halo_exchange_rdma(x, "sp", halo)
+
+        want_lo, want_hi = (np.asarray(a) for a in plain(x))
+        lo1, hi1, landed = jax.jit(body)(x, lo_b, hi_b)
+        np.testing.assert_array_equal(np.asarray(lo1), want_lo)
+        np.testing.assert_array_equal(np.asarray(hi1), want_hi)
+        # steady state: re-thread the landed buffers into the next call
+        lo2, hi2, _ = jax.jit(body)(x, *landed)
+        np.testing.assert_array_equal(np.asarray(lo2), want_lo)
+        np.testing.assert_array_equal(np.asarray(hi2), want_hi)
+        # the pool really sub-allocated arena ranges for the buffers
         assert len(pool.allocations) == 2
         assert all(r["offset"] % pool.alignment == 0
                    for r in pool.allocations)

@@ -14,8 +14,10 @@ import subprocess
 import sys
 import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+# APEX_TPU_ROOT keeps the gate, revert, and commit operating on the SAME
+# tree as the jobs when the queue is dry-run from copied job files
+ROOT = os.environ.get("APEX_TPU_ROOT") or os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
 
 # fast, targeted: the tests that exercise the exact kernels the
 # self-applying jobs patch (flash blocks, softmax, fused Adam)
@@ -58,3 +60,22 @@ def run_test_gate(tests: list[str] | None = None,
 def revert_file(path: str) -> None:
     """Drop an uncommitted patch to ``path`` (gate failed)."""
     subprocess.run(["git", "checkout", "--", path], cwd=ROOT, check=True)
+
+
+def gated_commit(kpath: str, message: str) -> dict:
+    """Shared q080/q085 flow: run the parity gate on the already-patched
+    ``kpath``; revert on failure, RAISE on gate timeout (transient — the
+    worker's retry-with-backoff should re-run the job), commit on pass.
+    Returns {applied, gate}."""
+    gate = run_test_gate()
+    if gate["rc"] == -1:
+        revert_file(kpath)
+        raise AssertionError(
+            f"commit gate timed out: {gate['tail'][-300:]}")
+    if not gate["ok"]:
+        revert_file(kpath)
+        return {"applied": False, "gate": gate}
+    subprocess.run(["git", "add", "--", kpath], cwd=ROOT, check=True)
+    subprocess.run(["git", "commit", "-q", "-m", message, "--", kpath],
+                   cwd=ROOT, check=True)
+    return {"applied": True, "gate": gate}

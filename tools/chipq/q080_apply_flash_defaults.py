@@ -11,7 +11,6 @@ order; fails (and retries later) if the sweep output is absent.
 import json
 import os
 import re
-import subprocess
 import sys
 import time
 
@@ -59,33 +58,26 @@ cur_q = int(re.search(r"DEFAULT_BLOCK_Q = (\d+)", src).group(1))
 cur_k = int(re.search(r"DEFAULT_BLOCK_K = (\d+)", src).group(1))
 changed = (cur_q, cur_k) != (bq, bk)
 gate = None
+# source is only ever patched from an on-chip run: an allowed-CPU dry-run
+# stops at the parse (the apply jobs have no legitimate CPU mode)
+if changed and jax.default_backend() != "tpu":
+    changed = False
 if changed:
     src = re.sub(r"DEFAULT_BLOCK_Q = \d+", f"DEFAULT_BLOCK_Q = {bq}", src)
     src = re.sub(r"DEFAULT_BLOCK_K = \d+", f"DEFAULT_BLOCK_K = {bk}", src)
     open(kpath, "w").write(src)
-    # commit gate (VERDICT r4 item 8): the fast parity subset must pass on
-    # the patched source before the autonomous commit; a failing gate
-    # reverts the patch instead of committing it
-    from _gate import revert_file, run_test_gate
+    # commit gate (VERDICT r4 item 8): the fast parity subset must pass
+    # on the patched source before the autonomous commit (revert on
+    # failure, raise on timeout so the worker's backoff retries)
+    from _gate import gated_commit
 
-    gate = run_test_gate()
-    if gate["rc"] == -1:
-        # gate TIMEOUT is transient (loaded host), not a verdict on the
-        # patch: revert and raise so the worker's retry-with-backoff
-        # machinery re-runs this job instead of parking it as done
-        revert_file(kpath)
-        raise AssertionError(f"commit gate timed out: {gate['tail'][-300:]}")
-    if not gate["ok"]:
-        revert_file(kpath)
-        changed = False
-    else:
-        subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
-        subprocess.run(
-            ["git", "commit", "-q", "-m",
-             f"Set flash block defaults from on-chip sweep: bq={bq} bk={bk} "
-             f"(was {cur_q}/{cur_k}; fwd {best.get('fwd_tflops')} TFLOPs, "
-             f"mxu {best.get('fwd_mxu')}; parity gate passed)"],
-            cwd=ROOT, check=True)
+    res = gated_commit(
+        kpath,
+        f"Set flash block defaults from on-chip sweep: bq={bq} bk={bk} "
+        f"(was {cur_q}/{cur_k}; fwd {best.get('fwd_tflops')} TFLOPs, "
+        f"mxu {best.get('fwd_mxu')}; parity gate passed)")
+    gate = res["gate"]
+    changed = res["applied"]
 
 # verify: re-measure through the frontend at the (possibly new) defaults
 import importlib  # noqa: E402

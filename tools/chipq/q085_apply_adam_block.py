@@ -9,7 +9,6 @@ Only commits when the winner beats the current default's measured frac by
 import json
 import os
 import re
-import subprocess
 import sys
 import time
 
@@ -62,32 +61,26 @@ apply = (cur_frac is not None
          and int(best["block_rows"]) != cur
          and best["hbm_frac"] > cur_frac * 1.02)
 gate = None
+# source is only ever patched from an on-chip run: an allowed-CPU dry-run
+# stops at the parse (the apply jobs have no legitimate CPU mode)
+if apply and jax.default_backend() != "tpu":
+    apply = False
 if apply:
     src = re.sub(r"DEFAULT_BLOCK_ROWS = \d+",
                  f"DEFAULT_BLOCK_ROWS = {int(best['block_rows'])}", src)
     open(kpath, "w").write(src)
     # commit gate (VERDICT r4 item 8): parity subset must pass on the
-    # patched source; failure reverts instead of committing
-    from _gate import revert_file, run_test_gate
+    # patched source (revert on failure, raise on timeout so the
+    # worker's backoff retries)
+    from _gate import gated_commit
 
-    gate = run_test_gate()
-    if gate["rc"] == -1:
-        # gate TIMEOUT is transient (loaded host), not a verdict on the
-        # patch: revert and raise so the worker's retry-with-backoff
-        # machinery re-runs this job instead of parking it as done
-        revert_file(kpath)
-        raise AssertionError(f"commit gate timed out: {gate['tail'][-300:]}")
-    if not gate["ok"]:
-        revert_file(kpath)
-        apply = False
-    else:
-        subprocess.run(["git", "add", kpath], cwd=ROOT, check=True)
-        subprocess.run(
-            ["git", "commit", "-q", "-m",
-             f"Set fused-Adam streaming block from on-chip sweep: "
-             f"{best['block_rows']} rows ({best['hbm_frac']} HBM frac vs "
-             f"{cur_frac} at {cur}; parity gate passed)"],
-            cwd=ROOT, check=True)
+    res = gated_commit(
+        kpath,
+        f"Set fused-Adam streaming block from on-chip sweep: "
+        f"{best['block_rows']} rows ({best['hbm_frac']} HBM frac vs "
+        f"{cur_frac} at {cur}; parity gate passed)")
+    gate = res["gate"]
+    apply = res["applied"]
 
 import bench  # noqa: E402
 
