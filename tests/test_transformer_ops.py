@@ -453,7 +453,8 @@ class TestLinearCrossEntropy:
                                           padding_idx)
 
     @pytest.mark.parametrize("v,chunk", [(1000, 256), (777, 256),
-                                         (512, 512), (130, 64)])
+                                         (512, 512), (130, 64),
+                                         (100, 256), (50, 8192)])
     def test_loss_matches_dense(self, v, chunk):
         from apex_tpu.transformer import linear_cross_entropy
 

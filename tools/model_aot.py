@@ -21,30 +21,15 @@ import os
 import sys
 import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if ROOT not in sys.path:
-    sys.path.insert(0, ROOT)
-
-os.environ["APEX_TPU_FORCE_COMPILED"] = "1"
-os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+# shared compile-only scaffolding (env + CPU pin + cache) — must import
+# before jax backend use
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _aot_common import (ROOT, atomic_write_json,  # noqa: E402
+                         get_topology)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:  # persistent cache: deviceless AOT compiles are cache-keyed, so
-    # re-runs (tests, artifact refreshes) skip recompilation
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(ROOT, ".jax_cache"))
-except Exception:
-    pass
-
 import jax.numpy as jnp  # noqa: E402
-from jax.experimental import topologies  # noqa: E402
 from jax.sharding import SingleDeviceSharding  # noqa: E402
-
-from bench import atomic_write_json  # noqa: E402
 
 OUT_PATH = os.environ.get("MODEL_AOT_OUT",
                           os.path.join(ROOT, "MODEL_AOT.json"))
@@ -210,8 +195,7 @@ NOTES = {
 
 def main():
     t0 = time.time()
-    topo = topologies.get_topology_desc(
-        os.environ.get("MOSAIC_AOT_TOPOLOGY", "v5e:2x2"), "tpu")
+    topo = get_topology()
     s = SingleDeviceSharding(topo.devices[0])
     chip = {"tflops": 394.0, "hbm_gbps": 819.0}  # v5e bf16 peaks
     result = {"device_kind": getattr(topo.devices[0], "device_kind", "?"),

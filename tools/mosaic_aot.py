@@ -29,40 +29,21 @@ import os
 import sys
 import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if ROOT not in sys.path:
-    sys.path.insert(0, ROOT)
-
-# kernels must pick the compiled (Mosaic) lowering even though the default
-# backend is CPU — see apex_tpu/utils/env.py:interpret_default
-os.environ["APEX_TPU_FORCE_COMPILED"] = "1"
-# quiet libtpu's host-metadata probing (no real TPU VM here)
-os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+# shared compile-only scaffolding (env + CPU pin + cache) — must import
+# before jax backend use
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _aot_common import (ROOT, atomic_write_json,  # noqa: E402
+                         get_topology)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:  # persistent cache: deviceless AOT compiles are cache-keyed, so
-    # re-runs (tests, artifact refreshes) skip recompilation
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(ROOT, ".jax_cache"))
-except Exception:
-    pass  # host stays off the relay
-
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
-from jax.experimental import topologies  # noqa: E402
 from jax.sharding import Mesh, NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 from jax.sharding import SingleDeviceSharding  # noqa: E402
 
-TOPO_NAME = os.environ.get("MOSAIC_AOT_TOPOLOGY", "v5e:2x2")
 OUT_PATH = os.environ.get("MOSAIC_AOT_OUT",
                           os.path.join(ROOT, "MOSAIC_AOT.json"))
-
-from bench import atomic_write_json  # noqa: E402
 
 
 def _struct(shape, dtype, sharding):
@@ -268,12 +249,12 @@ def build_cases(dev_sharding, mesh):
 
 def main():
     t0 = time.time()
-    topo = topologies.get_topology_desc(TOPO_NAME, "tpu")
+    topo = get_topology()
     devs = topo.devices
     dev_sharding = SingleDeviceSharding(devs[0])
     nmesh = min(4, len(devs))
     mesh = Mesh(np.array(devs[:nmesh]).reshape(nmesh), ("x",))
-    result = {"topology": TOPO_NAME,
+    result = {"topology": os.environ.get("MOSAIC_AOT_TOPOLOGY", "v5e:2x2"),
               "device_kind": getattr(devs[0], "device_kind", "?"),
               "n_devices": len(devs),
               "jax": jax.__version__,

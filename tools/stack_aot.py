@@ -23,32 +23,18 @@ import os
 import sys
 import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-if ROOT not in sys.path:
-    sys.path.insert(0, ROOT)
-
-os.environ["APEX_TPU_FORCE_COMPILED"] = "1"
-os.environ.setdefault("TPU_ACCELERATOR_TYPE", "v5litepod-4")
-os.environ.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+# shared compile-only scaffolding (env + CPU pin + cache) — must import
+# before jax backend use
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _aot_common import (ROOT, atomic_write_json,  # noqa: E402
+                         get_topology)
 
 import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:  # persistent cache: deviceless AOT compiles are cache-keyed, so
-    # re-runs (tests, artifact refreshes) skip recompilation
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(ROOT, ".jax_cache"))
-except Exception:
-    pass
-
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax.experimental import topologies  # noqa: E402
 from jax.sharding import Mesh, NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
-
-from bench import atomic_write_json  # noqa: E402
 
 OUT_PATH = os.environ.get("STACK_AOT_OUT",
                           os.path.join(ROOT, "STACK_AOT.json"))
@@ -212,8 +198,7 @@ def compile_zero_adam_16dev(mesh16d):
 
 def main():
     t0 = time.time()
-    topo = topologies.get_topology_desc(
-        os.environ.get("MOSAIC_AOT_TOPOLOGY", "v5e:2x2"), "tpu")
+    topo = get_topology()
     devs = np.array(topo.devices[:4])
     mesh_data = Mesh(devs.reshape(4), ("data",))
     mesh_2d = Mesh(devs.reshape(2, 2), ("data", "rep"))
