@@ -88,7 +88,7 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         c = self.cfg
         b, s = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
@@ -100,6 +100,11 @@ class GPT2(nn.Module):
         for i in range(c.n_layer):
             x = Block(c, name=f"h_{i}")(x)
         x = FusedLayerNorm(c.n_embd, name="ln_f")(x)
+        if return_hidden:
+            # pre-logits hidden states, for the chunked-vocab fused head
+            # (transformer.linear_cross_entropy) — the logits matmul is
+            # then fused into the loss and never materialized
+            return x
         logits = jax.lax.dot_general(
             x, wte.astype(c.compute_dtype), (((2,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)

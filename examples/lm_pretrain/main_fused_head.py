@@ -1,0 +1,85 @@
+"""Tiny LM pretrain loop exercising the fused linear+cross-entropy head.
+
+Mirrors the reference's runnable-examples convention
+(/root/reference/examples/simple): a GPT-2-tiny backbone trained with
+``transformer.linear_cross_entropy`` — the chunked-vocab head whose
+logits never materialize in HBM — updated by FusedAdam.
+
+Run (CPU or TPU):
+    JAX_PLATFORMS=cpu python examples/lm_pretrain/main_fused_head.py \
+        --steps 4 --vocab-chunk 256
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--vocab-chunk", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    from apex_tpu.models.gpt2 import GPT2, GPT2Config
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer import linear_cross_entropy
+
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (args.batch, args.seq), 0,
+                                cfg.vocab_size, jnp.int32)
+    # next-token targets; the final position has no successor — mark it
+    # with the ignore index so it contributes zero loss and zero grad
+    # (the wraparound pair tokens[:, -1] -> tokens[:, 0] is noise)
+    PAD = -100
+    targets = jnp.roll(tokens, -1, axis=1).at[:, -1].set(PAD)
+
+    full = model.init(jax.random.PRNGKey(1), tokens)
+    params = full["params"]
+
+    # split the LM head (tied embedding) out: the fused head consumes
+    # hidden states + the embedding matrix directly
+    def loss_fn(params):
+        hidden = model.apply({"params": params}, tokens,
+                             return_hidden=True)
+        wte = params["wte"]  # (V, H) tied LM head
+        loss = linear_cross_entropy(
+            hidden.reshape(-1, hidden.shape[-1]),
+            wte.T.astype(hidden.dtype),
+            targets.reshape(-1), 0.0, PAD, args.vocab_chunk)
+        return jnp.mean(loss)
+
+    opt = FusedAdam(params, lr=args.lr)
+
+    @jax.jit
+    def grads_of(params):
+        return jax.value_and_grad(loss_fn)(params)
+
+    l0 = loss = None
+    for step in range(args.steps):
+        loss, grads = grads_of(params)
+        params = opt.step(grads)
+        if l0 is None:
+            l0 = float(loss)
+        print(f"step {step}: loss {float(loss):.4f}", flush=True)
+    if args.steps >= 2:
+        assert float(loss) < l0, "loss did not fall"
+        print(f"OK: fused-head LM loss fell {l0:.4f} -> {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

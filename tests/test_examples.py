@@ -24,6 +24,8 @@ def _run(args):
 @pytest.mark.parametrize("args", [
     ["examples/simple/main_amp.py", "--steps", "4"],
     ["examples/dcgan/main_amp.py", "--steps", "2", "--batch", "4"],
+    ["examples/lm_pretrain/main_fused_head.py", "--steps", "3",
+     "--vocab-chunk", "128"],
 ])
 def test_example_runs(args):
     r = _run(args)

@@ -158,3 +158,22 @@ class TestProf:
             x = jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128)))
         dt = t.stop(block_on=x)
         assert dt > 0 and t.avg > 0
+
+
+class TestReturnHidden:
+    def test_hidden_matmul_equals_logits(self):
+        """return_hidden=True exposes the pre-logits states the fused
+        LM head consumes: hidden @ wte.T must equal the normal logits."""
+        cfg = GPT2Config.tiny()
+        model = GPT2(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0,
+                                    cfg.vocab_size, jnp.int32)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        logits = model.apply(params, tokens)
+        hidden = model.apply(params, tokens, return_hidden=True)
+        wte = params["params"]["wte"]
+        again = jnp.einsum("bsh,vh->bsv", hidden,
+                           wte.astype(hidden.dtype),
+                           preferred_element_type=jnp.float32)
+        np.testing.assert_allclose(np.asarray(again), np.asarray(logits),
+                                   rtol=1e-5, atol=1e-5)
