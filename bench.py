@@ -354,6 +354,7 @@ def bench_bert_lamb(jax, jnp, on_tpu, chip, floor_s):
     with fused LAMB — exercises FusedRMSNorm-class fused LN, xentropy-style
     loss, and the two-phase LAMB trust-ratio update
     (csrc/multi_tensor_lamb.cu via optimizers/functional.lamb_update)."""
+    from apex_tpu.contrib.xentropy import softmax_cross_entropy_loss
     from apex_tpu.models.bert import Bert, BertConfig
     from apex_tpu.optimizers.functional import lamb_update
     from apex_tpu.utils.benchtime import timed_steps
@@ -380,10 +381,10 @@ def bench_bert_lamb(jax, jnp, on_tpu, chip, floor_s):
 
         def loss_fn(p):
             logits = model.apply({"params": p}, tokens)
-            onehot = jax.nn.one_hot(labels, logits.shape[-1])
-            return -jnp.mean(jnp.sum(
-                jax.nn.log_softmax(logits.astype(jnp.float32)) * onehot,
-                axis=-1))
+            # the BASELINE config-4 loss: contrib.xentropy (gather-based
+            # fused CE, one lse residual) — not an O(N·V) onehot matmul
+            return jnp.mean(softmax_cross_entropy_loss(
+                logits.astype(jnp.float32), labels))
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         params, m, v, _gnorm = lamb_update(params, grads, m, v, step=i + 1,

@@ -42,11 +42,6 @@ def main():
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(key, (args.batch, args.seq), 0,
                                 cfg.vocab_size, jnp.int32)
-    # next-token targets; the final position has no successor — mark it
-    # with the ignore index so it contributes zero loss and zero grad
-    # (the wraparound pair tokens[:, -1] -> tokens[:, 0] is noise)
-    PAD = -100
-    targets = jnp.roll(tokens, -1, axis=1).at[:, -1].set(PAD)
 
     full = model.init(jax.random.PRNGKey(1), tokens)
     params = full["params"]
@@ -57,10 +52,12 @@ def main():
         hidden = model.apply({"params": params}, tokens,
                              return_hidden=True)
         wte = params["wte"]  # (V, H) tied LM head
+        # next-token pairs via the repo's slice convention (gpt2.lm_loss):
+        # position i predicts token i+1; the final position has no target
         loss = linear_cross_entropy(
-            hidden.reshape(-1, hidden.shape[-1]),
+            hidden[:, :-1].reshape(-1, hidden.shape[-1]),
             wte.T.astype(hidden.dtype),
-            targets.reshape(-1), 0.0, PAD, args.vocab_chunk)
+            tokens[:, 1:].reshape(-1), 0.0, None, args.vocab_chunk)
         return jnp.mean(loss)
 
     opt = FusedAdam(params, lr=args.lr)
