@@ -14,7 +14,7 @@ scale update) stays inside one jit (SURVEY §7 hard part (f)).
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Tuple
+from typing import Any, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,13 +49,17 @@ class DynamicGradScaler:
     def __init__(self, init_scale: float = 2.0 ** 16,
                  growth_factor: float = 2.0, backoff_factor: float = 0.5,
                  growth_interval: int = 2000, hysteresis: int = 1,
-                 enabled: bool = True):
+                 enabled: bool = True, min_scale: Optional[float] = None):
         self.init_scale = init_scale
         self.growth_factor = growth_factor
         self.backoff_factor = backoff_factor
         self.growth_interval = growth_interval
         self.hysteresis = hysteresis
         self.enabled = enabled
+        # floor under backoff: an overflow storm (every step non-finite)
+        # would otherwise halve the scale to denormal/zero, silently
+        # flushing all gradients — the failure mode resilience.step guards
+        self.min_scale = min_scale
 
     def init(self) -> ScalerState:
         return ScalerState.create(self.init_scale, self.hysteresis)
@@ -72,14 +76,26 @@ class DynamicGradScaler:
         inv = 1.0 / state.scale
         return multi_tensor_scale(grads, inv)
 
-    def update(self, state: ScalerState, found_inf) -> ScalerState:
-        """Advance the scale state machine given this step's found_inf."""
+    def update(self, state: ScalerState, found_inf,
+               freeze_growth: bool = False) -> ScalerState:
+        """Advance the scale state machine given this step's found_inf.
+
+        ``freeze_growth=True`` (the overflow-storm degraded mode set by
+        :mod:`apex_tpu.resilience.step`) permits backoff but suppresses
+        growth, so a recovering run can't immediately re-overflow;
+        ``min_scale`` clamps backoff so a storm can't collapse the scale
+        to zero. Both are static at trace time.
+        """
         if not self.enabled:
             return state
         s, g, h = update_scale_hysteresis(
             state.scale, state.growth_tracker, state.hysteresis_tracker,
             found_inf, self.growth_factor, self.backoff_factor,
             self.growth_interval, self.hysteresis)
+        if freeze_growth:
+            s = jnp.minimum(s, state.scale)
+        if self.min_scale is not None:
+            s = jnp.maximum(s, jnp.float32(self.min_scale))
         return ScalerState(s, g, h)
 
 

@@ -51,13 +51,27 @@ def _inline_bench() -> None:
 
 
 def main() -> None:
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    bench = os.path.join(here, "bench.py")
-    if os.path.exists(bench):
-        sys.argv = [bench] + sys.argv[1:]
-        runpy.run_path(bench, run_name="__main__")
-        return
-    _inline_bench()
+    # a preempted bench run (SIGTERM from the scheduler) exits cleanly with
+    # a structured record instead of a stack trace mid-measurement; there is
+    # no step boundary to poll, so the guard raises to unwind immediately
+    from apex_tpu.resilience import PreemptionGuard
+    from apex_tpu.utils.logging import structured_warning
+
+    with PreemptionGuard(raise_on_signal=True) as guard:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        bench = os.path.join(here, "bench.py")
+        if os.path.exists(bench):
+            sys.argv = [bench] + sys.argv[1:]
+            runpy.run_path(bench, run_name="__main__")
+        else:
+            _inline_bench()
+    if guard.should_stop():
+        structured_warning("bench_preempted",
+                           signal=guard.received_signal,
+                           action="results above this line are complete")
+        # a truncated run must not read as a successful benchmark to the
+        # caller's exit-code check; keep the conventional signal status
+        sys.exit(128 + guard.received_signal)
 
 
 if __name__ == "__main__":

@@ -40,7 +40,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.utils.compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.models.gpt2 import GPT2Config
@@ -155,7 +155,7 @@ def _block_apply(cfg: GPT2Config, blk, x, sp_strategy: str = "ring"):
     """
     cd = cfg.compute_dtype
     e = cfg.n_embd
-    tp = jax.lax.axis_size("tp")
+    tp = axis_size("tp")
     h_local = cfg.n_head // tp
     d = e // cfg.n_head
     b, s_local, _ = x.shape
@@ -200,14 +200,14 @@ def _forward_local(cfg: GPT2Config, params, tokens, targets, mask,
     """Per-shard forward: tokens (b_local, s_local) on a (dp, tp, sp) mesh."""
     cd = cfg.compute_dtype
     e = cfg.n_embd
-    tp = jax.lax.axis_size("tp")
+    tp = axis_size("tp")
     h_local = cfg.n_head // tp
     d = e // cfg.n_head
 
     # wpe is sp-sharded over positions; the parallel path trains at full
     # context length (seq == n_positions) so position shards align with
     # sequence shards
-    sp = jax.lax.axis_size("sp")
+    sp = axis_size("sp")
     assert tokens.shape[1] * sp == cfg.n_positions, (
         f"parallel GPT-2 requires seq == n_positions "
         f"({tokens.shape[1]}*{sp} != {cfg.n_positions})")
@@ -253,8 +253,8 @@ def make_train_step(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
         # exactly (dp·tp·sp)× the true gradient, for every param class
         # (verified empirically across (2,1,1)...(8,1,1),(1,8,1),(4,2,1),
         # (1,2,4) meshes). Normalize by the total mesh size.
-        n_total = (jax.lax.axis_size("dp") * jax.lax.axis_size("tp")
-                   * jax.lax.axis_size("sp"))
+        n_total = (axis_size("dp") * axis_size("tp")
+                   * axis_size("sp"))
 
         def sync(g, axes):
             for ax in axes.split("|"):
@@ -403,7 +403,7 @@ def make_train_step_pp(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
 
         def stage_fn(stage_blocks, shared_, x_act, tok, tgt, msk):
             my_pp = jax.lax.axis_index("pp")
-            last = my_pp == jax.lax.axis_size("pp") - 1
+            last = my_pp == axis_size("pp") - 1
             # cond (not where): only stage 0 pays the (vocab, e) embedding
             # gather — and its scatter-add cotangent — per tick; mirrors the
             # lax.cond gating of the vocab-logits loss on the last stage
@@ -441,8 +441,8 @@ def make_train_step_pp(cfg: GPT2Config, mesh: Mesh, lr: float = 1e-4,
         # with check_vma=False each sync psum re-broadcasts the seed
         # cotangent, giving n_total× the true grad; pp is handled inside the
         # pipeline for shared params and absent for block params)
-        n_total = (jax.lax.axis_size("dp") * jax.lax.axis_size("tp")
-                   * jax.lax.axis_size("sp") * jax.lax.axis_size("ep"))
+        n_total = (axis_size("dp") * axis_size("tp")
+                   * axis_size("sp") * axis_size("ep"))
 
         def sync(g, axes):
             for ax in axes.split("|"):

@@ -1,0 +1,14 @@
+"""Version compatibility shims for the Pallas TPU API surface.
+
+jax renamed ``pltpu.TPUCompilerParams`` to ``pltpu.CompilerParams`` (same
+constructor: ``dimension_semantics``, ``vmem_limit_bytes``, ...). Kernels
+import the resolved name from here so the package imports — and the whole
+test tier collects — on either side of the rename.
+"""
+
+from __future__ import annotations
+
+import jax.experimental.pallas.tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

@@ -30,6 +30,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from apex_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils.env import interpret_default
@@ -204,7 +206,7 @@ def _group_norm_one_pass(x3, n, hw, c, g, weight, bias, eps, act,
         out_shape=[jax.ShapeDtypeStruct((n, hw, c), x3.dtype),
                    jax.ShapeDtypeStruct((n, 1, g), _f32),
                    jax.ShapeDtypeStruct((n, 1, g), _f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*args)

@@ -26,12 +26,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.utils.compat import axis_size
+
 from apex_tpu.utils.env import interpret_default
 
 
 def _shift_kernel(x_ref, o_ref, send_sem, recv_sem, *, axis_name, shift):
     my = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     dst = jax.lax.rem(my + shift + n, n)
     rdma = pltpu.make_async_remote_copy(
         src_ref=x_ref, dst_ref=o_ref, send_sem=send_sem, recv_sem=recv_sem,
@@ -99,7 +101,7 @@ def _halo_kernel(x_ref, lo_ref, hi_ref, slo, shi, rlo, rhi, *,
     the entire ref (no slice at all) when the shard is too small or not
     tile-aligned."""
     my = jax.lax.axis_index(axis_name)
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     left = jax.lax.rem(my - 1 + n, n)
     right = jax.lax.rem(my + 1, n)
     if full:
@@ -198,7 +200,7 @@ def halo_exchange_rdma(x: jax.Array, axis_name: str, halo: int,
         out_bufs = (lo_buf, hi_buf)
     if not periodic:
         idx = jax.lax.axis_index(axis_name)
-        n = jax.lax.axis_size(axis_name)
+        n = axis_size(axis_name)
         lo = jnp.where(idx == 0, jnp.zeros_like(lo), lo)
         hi = jnp.where(idx == n - 1, jnp.zeros_like(hi), hi)
     if return_bufs:

@@ -25,6 +25,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from apex_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.utils.env import interpret_default
@@ -150,7 +152,7 @@ def _softmax_fwd_causal_chunked(x3, *, scale, interpret):
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, sqp, skp), x3.dtype),
         scratch_shapes=[pltpu.VMEM((br, skp), _f32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(xp)
@@ -210,7 +212,7 @@ def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
         out_specs=pl.BlockSpec((1, br, skp), lambda b, i: (b, i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((B, sqp, skp), x3.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(*operands)
@@ -237,7 +239,7 @@ def softmax_bwd_pallas(y3, dy3, *, scale, interpret=None):
         in_specs=[spec, spec],
         out_specs=spec,
         out_shape=jax.ShapeDtypeStruct((B, sqp, skp), y3.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel")),
         interpret=interpret,
     )(yp, dyp)

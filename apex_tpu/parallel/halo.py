@@ -21,6 +21,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.compat import axis_size
+
 
 def left_right_halo_exchange(left_output_halo: jax.Array,
                              right_output_halo: jax.Array,
@@ -30,7 +32,7 @@ def left_right_halo_exchange(left_output_halo: jax.Array,
     from the left / right neighbor respectively (nccl_p2p.cpp:24 semantics,
     non-periodic: edge devices receive zeros).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     # right-going: my right halo → right neighbor's left input
     right_perm = [(i, i + 1) for i in range(n - 1)]
@@ -93,7 +95,7 @@ class HaloExchangerAllGather(HaloExchanger):
     parity/testing like the reference."""
 
     def left_right_halo_exchange(self, left_output_halo, right_output_halo):
-        n = jax.lax.axis_size(self.axis_name)
+        n = axis_size(self.axis_name)
         idx = jax.lax.axis_index(self.axis_name)
         lefts = jax.lax.all_gather(left_output_halo, self.axis_name)
         rights = jax.lax.all_gather(right_output_halo, self.axis_name)

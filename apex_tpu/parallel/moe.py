@@ -18,6 +18,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.compat import axis_size
+
 _f32 = jnp.float32
 
 
@@ -53,7 +55,7 @@ def moe_ffn_ep(x: jax.Array, gate_w: jax.Array, w1: jax.Array,
     (pass stacked experts with in_specs=P('ep', ...)).
     Returns (T, D): combined expert outputs (dropped tokens → zeros).
     """
-    ep = jax.lax.axis_size(axis_name)
+    ep = axis_size(axis_name)
     t, d = x.shape
     e_local = w1.shape[0]
     e = e_local * ep

@@ -35,6 +35,8 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.compat import axis_size
+
 
 def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
                    axis_name: str = "pp",
@@ -51,7 +53,7 @@ def pipeline_apply(stage_fn: Callable, stage_params: Any, x: jax.Array,
     Returns the pipeline output (B, ...) — valid on every device (the last
     stage's results are broadcast back over the axis).
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     m = num_microbatches
     b = x.shape[0]
@@ -130,7 +132,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params: Any,
     the axis), ``stage_grads`` are THIS stage's param grads (local, not
     psum'd over pp), and ``shared_grads`` are psum'd over the pipeline axis.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     m = num_microbatches
     depth = 2 * p  # stash ring: ≥ max microbatches in flight + 1

@@ -48,6 +48,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.compat import axis_size
+
 from apex_tpu.ops.pallas.flash_attention import (flash_attention_bwd,
                                                  flash_attention_fwd)
 
@@ -87,7 +89,7 @@ def _rotate(x, axis_name, perm, transport):
 
 def _ring_fwd_impl(q, k, v, axis_name, causal, s, block_q, block_k,
                    transport="collective"):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
 
     # step 0: diagonal block — causal within the local shard
@@ -164,7 +166,7 @@ def _ring_vjp_bwd(axis_name, causal, scale, block_q, block_k, transport,
                   res, do):
     q, k, v, o, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     lse = lse.astype(_f32)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -268,7 +270,7 @@ def zigzag_unshard(x, n: int, axis: int = 2):
 def _zz_fwd_impl(q, k, v, axis_name, s, block_q, block_k,
                  transport="collective"):
     """Causal zigzag ring forward. Local layout: [low chunk, high chunk]."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     c = q.shape[2] // 2
 
@@ -357,7 +359,7 @@ def _zz_vjp_fwd(q, k, v, axis_name, scale, block_q, block_k, transport):
 def _zz_vjp_bwd(axis_name, scale, block_q, block_k, transport, res, do):
     q, k, v, o, lse = res
     s = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     c = q.shape[2] // 2
     lse = lse.astype(_f32)

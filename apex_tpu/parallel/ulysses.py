@@ -31,6 +31,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.utils.compat import axis_size
+
 from apex_tpu.ops.pallas.flash_attention import flash_attention
 
 
@@ -61,7 +63,7 @@ def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     Differentiable (all_to_all is its own transpose, so the backward is two
     all-to-alls around the flash backward — no custom VJP needed).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     b, h, s_local, d = q.shape
     if h % n != 0:
         raise ValueError(

@@ -1,0 +1,33 @@
+"""apex_tpu.resilience — fault-tolerant checkpointing + training resilience.
+
+Three cooperating layers for surviving what production training actually
+throws at a run:
+
+- :mod:`~apex_tpu.resilience.checkpoint_manager` — step-numbered atomic
+  checkpoints with manifests/checksums, retention, retry-with-backoff, and
+  a ``restore_latest`` that skips corrupt/partial steps.
+- :mod:`~apex_tpu.resilience.preemption` — SIGTERM/SIGINT-aware
+  ``PreemptionGuard`` for save-and-stop on slice eviction.
+- :mod:`~apex_tpu.resilience.step` + :mod:`~apex_tpu.resilience.fault_injection`
+  — overflow-storm guard rails around ``amp.DynamicGradScaler`` and the
+  deterministic fault harness that proves all of the above under torn
+  writes, EIO, preemption, and NaN bursts.
+
+See docs/robustness.md for the checkpoint layout and semantics.
+"""
+
+from apex_tpu.resilience.checkpoint_manager import (  # noqa: F401
+    CheckpointCorruptError, CheckpointError, CheckpointManager, Filesystem)
+from apex_tpu.resilience.fault_injection import (  # noqa: F401
+    FaultInjector, SimulatedCrash)
+from apex_tpu.resilience.preemption import (  # noqa: F401
+    PreemptionGuard, PreemptionInterrupt)
+from apex_tpu.resilience.step import (  # noqa: F401
+    DEFAULT_SCALE_FLOOR, ResilientStep, resilient_step, skip_on_overflow)
+
+__all__ = [
+    "CheckpointCorruptError", "CheckpointError", "CheckpointManager",
+    "Filesystem", "FaultInjector", "SimulatedCrash", "PreemptionGuard",
+    "PreemptionInterrupt", "DEFAULT_SCALE_FLOOR", "ResilientStep",
+    "resilient_step", "skip_on_overflow",
+]

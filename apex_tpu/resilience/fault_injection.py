@@ -1,0 +1,136 @@
+"""Deterministic fault injection for resilience testing.
+
+Production failure modes, reproduced on a laptop with a seed:
+
+- **Filesystem faults** — interpose on the :class:`Filesystem` seam of
+  :class:`~apex_tpu.resilience.checkpoint_manager.CheckpointManager`:
+  ``fail_write(nth)`` raises ``OSError`` (EIO/ENOSPC) on the Nth write
+  call, ``torn_write(nth)`` writes a prefix of the bytes and then raises
+  :class:`SimulatedCrash` — exactly what a power cut or preemption leaves
+  on disk mid-save.
+- **Preemption** — ``fire_preemption()`` delivers a real SIGTERM to this
+  process so :class:`~apex_tpu.resilience.preemption.PreemptionGuard` runs
+  the same code path the scheduler triggers.
+- **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
+  window of steps whose gradients ``poison_grads`` fills with NaN/Inf
+  (choice seeded), reproducing the overflow storms that collapse a dynamic
+  loss scale.
+
+Everything is deterministic: the same seed + schedule produces the same
+faults on the same call sequence, so a failing resilience test replays
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import signal
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.resilience.checkpoint_manager import Filesystem
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by an injected torn write: models the process dying mid-save.
+
+    Tests catch this where a real run would simply be gone; everything the
+    'dead' process left on disk is the state under test.
+    """
+
+
+class _WriteFault:
+    def __init__(self, kind: str, err: int = errno.EIO,
+                 fraction: float = 0.5):
+        self.kind = kind  # "error" | "torn"
+        self.err = err
+        self.fraction = fraction
+
+
+class _InjectedFilesystem(Filesystem):
+    """Filesystem that consults the injector's fault schedule on each
+    write. Reads and directory ops pass through untouched — faults target
+    the durability path."""
+
+    def __init__(self, injector: "FaultInjector"):
+        self._injector = injector
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        fault = self._injector._next_write_fault()
+        if fault is None:
+            return super().write_bytes(path, data)
+        if fault.kind == "error":
+            raise OSError(fault.err, os.strerror(fault.err), path)
+        # torn write: a prefix reaches the disk, then the process "dies"
+        keep = int(len(data) * fault.fraction)
+        super().write_bytes(path, data[:keep])
+        raise SimulatedCrash(
+            f"torn write: {keep}/{len(data)} bytes of {path}")
+
+
+class FaultInjector:
+    """Seeded, scheduled fault source for the resilience test harness."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self._write_calls = 0
+        self._write_faults: Dict[int, _WriteFault] = {}
+        self._bursts: List[Tuple[int, int]] = []
+
+    # ---- filesystem faults ---------------------------------------------
+    def filesystem(self) -> Filesystem:
+        """A Filesystem to hand to CheckpointManager(fs=...)."""
+        return _InjectedFilesystem(self)
+
+    def fail_write(self, nth: int, err: int = errno.EIO,
+                   count: int = 1) -> "FaultInjector":
+        """Raise ``OSError(err)`` on write calls ``nth .. nth+count-1``
+        (1-based, counted across the injected filesystem's lifetime)."""
+        for n in range(nth, nth + count):
+            self._write_faults[n] = _WriteFault("error", err=err)
+        return self
+
+    def torn_write(self, nth: int, fraction: float = 0.5) -> "FaultInjector":
+        """On the Nth write, persist only ``fraction`` of the bytes and
+        raise :class:`SimulatedCrash`."""
+        self._write_faults[nth] = _WriteFault("torn", fraction=fraction)
+        return self
+
+    @property
+    def write_calls(self) -> int:
+        return self._write_calls
+
+    def _next_write_fault(self) -> Optional[_WriteFault]:
+        self._write_calls += 1
+        return self._write_faults.pop(self._write_calls, None)
+
+    # ---- preemption -----------------------------------------------------
+    def fire_preemption(self, sig: int = signal.SIGTERM) -> None:
+        """Deliver a real signal to this process (handled at the next
+        bytecode boundary of the main thread — same path as the scheduler's
+        SIGTERM)."""
+        os.kill(os.getpid(), sig)
+
+    # ---- gradient corruption -------------------------------------------
+    def nan_burst(self, start: int, length: int) -> "FaultInjector":
+        """Schedule steps ``start .. start+length-1`` to produce non-finite
+        gradients via :meth:`poison_grads`."""
+        self._bursts.append((start, length))
+        return self
+
+    def grads_faulty(self, step: int) -> bool:
+        return any(s <= step < s + n for s, n in self._bursts)
+
+    def poison_grads(self, grads: Any, step: int) -> Any:
+        """Return ``grads`` with every leaf filled with NaN or Inf when
+        ``step`` falls in a scheduled burst (seeded choice), else
+        unchanged."""
+        if not self.grads_faulty(step):
+            return grads
+        bad = jnp.nan if self.rng.random() < 0.5 else jnp.inf
+        return jax.tree_util.tree_map(
+            lambda g: jnp.full_like(g, bad), grads)

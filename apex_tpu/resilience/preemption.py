@@ -1,0 +1,164 @@
+"""Preemption handling for interruptible training (TPU slices get evicted).
+
+Cloud TPU (and most batch schedulers) deliver SIGTERM with a short grace
+window before the slice disappears. :class:`PreemptionGuard` converts that
+into cooperative shutdown: the signal handler only records the request (no
+I/O in handler context), the training loop polls ``should_stop()`` at step
+boundaries, and ``finalize()`` runs the registered final synchronous save
+exactly once. A second signal restores default handling so an operator's
+repeated Ctrl-C still kills a wedged process.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Callable, Iterable, Optional
+
+from apex_tpu.utils.logging import structured_warning
+
+
+class PreemptionInterrupt(BaseException):
+    """Raised in the main thread by a guard with ``raise_on_signal=True``.
+
+    A ``BaseException`` (like ``KeyboardInterrupt``) so straight-line code
+    with broad ``except Exception`` handlers — a benchmark, a data pipeline
+    — still unwinds promptly to the guard's ``with`` block.
+    """
+
+    def __init__(self, signum: int):
+        super().__init__(f"preempted by signal {signum}")
+        self.signum = signum
+
+
+class PreemptionGuard:
+    """Install SIGTERM/SIGINT handlers and expose ``should_stop()``.
+
+    Usage::
+
+        with PreemptionGuard(on_preempt=lambda: mgr.save(step, state)) as g:
+            for step in range(steps):
+                state = train_step(state)
+                if g.should_stop():
+                    break
+        # __exit__ runs finalize() (the final synchronous save) when a
+        # preemption was requested, then restores the previous handlers.
+
+    ``on_preempt`` runs in normal (loop) context, never inside the signal
+    handler — a save interrupted by its own trigger can't tear itself.
+    Signal handlers can only be installed from the main thread; elsewhere
+    the guard degrades to an inert ``should_stop() == False`` with a
+    structured warning rather than failing the training script.
+
+    For straight-line work with no step boundary to poll (a benchmark, a
+    one-shot export), ``raise_on_signal=True`` makes the handler raise
+    :class:`PreemptionInterrupt` in the main thread instead — the ``with``
+    body unwinds immediately and ``__exit__`` still runs ``on_preempt``.
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
+                                                 signal.SIGINT),
+                 on_preempt: Optional[Callable[[], None]] = None,
+                 raise_on_signal: bool = False):
+        self.signals = tuple(signals)
+        self.on_preempt = on_preempt
+        self.raise_on_signal = raise_on_signal
+        self._stop = threading.Event()
+        self._finalized = False
+        self._announced = False
+        self._received: Optional[int] = None
+        self._prev = {}
+        self._installed = False
+
+    # ---- lifecycle ------------------------------------------------------
+    def install(self) -> "PreemptionGuard":
+        if self._installed:
+            return self
+        try:
+            for sig in self.signals:
+                self._prev[sig] = signal.signal(sig, self._handler)
+            self._installed = True
+        except ValueError:  # not the main thread, or a bad signal number
+            # undo any handlers already installed this call — a half-armed
+            # guard the caller believes is inert must not keep intercepting
+            for sig, prev in self._prev.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):
+                    pass
+            self._prev.clear()
+            structured_warning(
+                "preemption_guard_inert",
+                reason="signal handlers require the main thread and valid "
+                       "signal numbers")
+        return self
+
+    def restore(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        handled = exc_type is not None and issubclass(exc_type,
+                                                      PreemptionInterrupt)
+        try:
+            if self.should_stop() and (exc_type is None or handled):
+                self.finalize()
+        finally:
+            self.restore()
+        # a PreemptionInterrupt we raised ourselves is handled here, not an
+        # error to propagate out of the with-block
+        return handled
+
+    # ---- signal path ----------------------------------------------------
+    def _handler(self, signum, frame) -> None:
+        # no I/O here: stderr may be mid-write in the interrupted frame and
+        # CPython forbids reentering a buffered writer — only record the
+        # request; the announcement happens in loop context (_announce)
+        first = not self._stop.is_set()
+        self._stop.set()
+        self._received = signum
+        if first:
+            if self.raise_on_signal:
+                raise PreemptionInterrupt(signum)
+        else:
+            # second signal: operator insists — restore default handling
+            # and re-deliver so THIS signal terminates the process
+            self.restore()
+            os.kill(os.getpid(), signum)
+
+    def _announce(self) -> None:
+        if self._announced or self._received is None:
+            return
+        self._announced = True
+        structured_warning("preemption_requested",
+                           signal=int(self._received),
+                           action="finishing step, then final save")
+
+    # ---- loop API -------------------------------------------------------
+    def should_stop(self) -> bool:
+        """True once a preemption signal has been received (cheap; poll
+        every step)."""
+        if self._stop.is_set():
+            self._announce()
+            return True
+        return False
+
+    @property
+    def received_signal(self) -> Optional[int]:
+        return self._received
+
+    def finalize(self) -> bool:
+        """Run the registered final synchronous save exactly once. Returns
+        True iff the callback ran (idempotent on repeat calls)."""
+        self._announce()
+        if self._finalized or self.on_preempt is None:
+            return False
+        self._finalized = True
+        self.on_preempt()
+        return True

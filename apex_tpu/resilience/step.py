@@ -1,0 +1,124 @@
+"""Overflow-resilient train-step wrapping (the compute-side guard rail).
+
+The reference's hysteresis state machine (csrc/update_scale_hysteresis.cu,
+ported as ``amp.DynamicGradScaler``) assumes overflows are occasional. An
+overflow *storm* — bad data shard, diverging run, or an injected NaN burst —
+makes every step non-finite: each one halves the scale, and within ~40 steps
+the scale underflows to zero and every subsequent gradient silently flushes
+to nothing. The loss curve goes flat and nobody is told why.
+
+:class:`ResilientStep` composes with the scaler to fail loudly and degrade
+gracefully instead:
+
+- every non-finite step is **skipped** (parameters keep their old values —
+  the jitted ``where`` keeps the whole flow on device);
+- the scale never backs off below ``scale_floor``;
+- after ``max_consecutive_overflows`` consecutive bad steps the wrapper
+  enters degraded mode: scale growth is frozen and a single
+  ``structured_warning`` (event ``overflow_storm``) is emitted for the
+  monitoring pipeline. ``reset_degraded()`` re-arms growth once the cause
+  is fixed.
+
+The one host sync per step is a scalar ``found_inf`` fetch — the value the
+loop needs anyway to count skips.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.grad_scaler import DynamicGradScaler, ScalerState
+from apex_tpu.utils.logging import structured_warning
+
+DEFAULT_SCALE_FLOOR = 2.0 ** -14  # smallest normal bf16/fp16-safe scale
+
+
+def skip_on_overflow(new_tree: Any, old_tree: Any, found_inf) -> Any:
+    """Per-leaf ``where``: keep the old value when this step overflowed.
+    Jit-safe; the apex 'skipped step' semantics for functional updates."""
+    return jax.tree_util.tree_map(
+        lambda new, old: jnp.where(found_inf, old, new), new_tree, old_tree)
+
+
+class ResilientStep:
+    """Wrap ``step_fn(params, sstate, *batch) -> (new_params, found_inf,
+    *aux)`` with skip-on-overflow and storm degradation.
+
+    Returns ``(params, sstate, found_inf, *aux)`` — params unchanged and
+    scale backed off (never below ``scale_floor``) on overflow steps. Use
+    via :func:`resilient_step` or directly::
+
+        step = resilient_step(train_step, scaler)
+        params, sstate, found_inf, loss = step(params, sstate, batch)
+        if step.degraded: ...  # storm happened; growth is frozen
+    """
+
+    def __init__(self, step_fn: Callable, scaler: DynamicGradScaler, *,
+                 max_consecutive_overflows: int = 8,
+                 scale_floor: float = DEFAULT_SCALE_FLOOR):
+        self.step_fn = step_fn
+        self.scaler = scaler
+        self.max_consecutive_overflows = max_consecutive_overflows
+        # the floor is applied in this wrapper's own (jitted) post-step, not
+        # by mutating the caller's scaler — a scaler shared with another
+        # loop keeps its configured backoff semantics. An explicit
+        # scaler.min_scale still applies (the tighter of the two wins).
+        self.scale_floor = scale_floor
+        self.consecutive_overflows = 0
+        self.skipped_steps = 0
+        self.degraded = False
+
+        def _post(new_params, params, sstate, found_inf, *, freeze_growth):
+            params = skip_on_overflow(new_params, params, found_inf)
+            sstate = self.scaler.update(sstate, found_inf,
+                                        freeze_growth=freeze_growth)
+            return params, sstate._replace(
+                scale=jnp.maximum(sstate.scale, jnp.float32(scale_floor)))
+
+        # one trace per freeze_growth value; everything but the scalar
+        # found_inf fetch below stays on device
+        self._post = jax.jit(_post, static_argnames=("freeze_growth",))
+
+    def __call__(self, params: Any, sstate: ScalerState, *batch):
+        new_params, found_inf, *aux = self.step_fn(params, sstate, *batch)
+        params, sstate = self._post(new_params, params, sstate, found_inf,
+                                    freeze_growth=self.degraded)
+        if bool(found_inf):
+            self.skipped_steps += 1
+            self.consecutive_overflows += 1
+            if (not self.degraded and self.consecutive_overflows
+                    >= self.max_consecutive_overflows):
+                self.degraded = True
+                structured_warning(
+                    "overflow_storm",
+                    consecutive_overflows=self.consecutive_overflows,
+                    scale=float(sstate.scale),
+                    scale_floor=self.scale_floor,
+                    action="loss-scale growth frozen; steps skipped until "
+                           "gradients are finite")
+        else:
+            self.consecutive_overflows = 0
+        return (params, sstate, found_inf, *aux)
+
+    def reset_degraded(self) -> None:
+        """Re-arm scale growth after the storm's cause is resolved."""
+        if self.degraded:
+            structured_warning("overflow_storm_cleared",
+                               skipped_steps=self.skipped_steps)
+        self.degraded = False
+        self.consecutive_overflows = 0
+
+    @property
+    def stats(self) -> Dict[str, Any]:
+        return {"skipped_steps": self.skipped_steps,
+                "consecutive_overflows": self.consecutive_overflows,
+                "degraded": self.degraded}
+
+
+def resilient_step(step_fn: Callable, scaler: DynamicGradScaler,
+                   **kwargs) -> ResilientStep:
+    """Convenience constructor for :class:`ResilientStep` (see class doc)."""
+    return ResilientStep(step_fn, scaler, **kwargs)

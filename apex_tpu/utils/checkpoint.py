@@ -31,19 +31,38 @@ def save(path: str, tree: Any) -> None:
 class AsyncSaveHandle:
     """Handle for an in-flight async save; ``wait()`` blocks until the
     checkpoint is durable, then releases the writer. ``wait()`` is
-    idempotent; a handle dropped without ``wait()`` warns at collection
-    time (the checkpoint on disk may be partial)."""
+    idempotent after success; after a writer failure every call re-raises
+    (a failed save must never later read as durable). A handle dropped
+    without a successful ``wait()`` warns at collection time (the
+    checkpoint on disk may be partial)."""
 
     def __init__(self, ckptr, path: str):
         self._ckptr = ckptr
         self._path = path
         self._done = False
+        self._error: Optional[RuntimeError] = None
 
     def wait(self) -> None:
         if self._done:
             return
+        if self._error is not None:
+            # the writer already failed: every later wait() must stay loud —
+            # returning quietly would report an unwritten checkpoint durable
+            raise self._error
+        try:
+            self._ckptr.wait_until_finished()
+        except Exception as e:
+            # a background-writer failure would otherwise surface as an
+            # opaque orbax error long after save_async returned; name the
+            # checkpoint it belongs to and release the writer
+            try:
+                self._ckptr.close()
+            except Exception:
+                pass
+            self._error = RuntimeError(
+                f"async checkpoint save to {self._path!r} failed: {e}")
+            raise self._error from e
         self._done = True
-        self._ckptr.wait_until_finished()
         self._ckptr.close()
 
     def __del__(self):
@@ -53,7 +72,9 @@ class AsyncSaveHandle:
         # would be silently swallowed anyway. The caller owns durability;
         # a dropped handle means an unverified checkpoint, and the warning
         # says so.
-        if not self._done:
+        if not self._done and self._error is None:
+            # (a handle whose wait() already raised was surfaced loudly to
+            # the caller — no second warning at collection time)
             import warnings
 
             warnings.warn(
@@ -96,21 +117,42 @@ def restore(path: str, like: Optional[Any] = None) -> Any:
 
 
 def save_numpy(path: str, tree: Any) -> None:
-    """Gather-on-host single-file save (v1 semantics)."""
+    """Gather-on-host single-file save (v1 semantics), atomic on POSIX.
+
+    The archive is staged to ``<path>.npz.tmp`` and published with
+    ``os.replace`` — a crash mid-save leaves the previous checkpoint (or
+    nothing) rather than a truncated ``.npz`` for restore to choke on.
+    """
     leaves, _ = jax.tree_util.tree_flatten(tree)
+    final = path if path.endswith(".npz") else path + ".npz"
+    tmp = final + ".tmp"
     # structure is reconstructed from `like` on restore (a PyTreeDef is not
     # serializable); only the leaves are stored
-    np.savez(path,
-             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)})
+    with open(tmp, "wb") as f:
+        np.savez(f, **{f"leaf_{i}": np.asarray(l)
+                       for i, l in enumerate(leaves)})
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)
 
 
 def restore_numpy(path: str, like: Any) -> Any:
     """Restore a save_numpy checkpoint into the structure of ``like``.
 
-    numpy stores extension dtypes (bfloat16, fp8) as raw void bytes; they are
-    viewed back through the dtype recorded in ``like``.
+    Accepts the path with or without the ``.npz`` suffix (matching whatever
+    ``save_numpy`` was given). numpy stores extension dtypes (bfloat16, fp8)
+    as raw void bytes; they are viewed back through the dtype recorded in
+    ``like``.
     """
-    data = np.load(path if path.endswith(".npz") else path + ".npz")
+    candidates = ([path] if path.endswith(".npz")
+                  else [path + ".npz", path])
+    for cand in candidates:
+        if os.path.isfile(cand):
+            break
+    else:
+        raise FileNotFoundError(
+            "no checkpoint at " + " or ".join(repr(c) for c in candidates))
+    data = np.load(cand)
     leaves, treedef = jax.tree_util.tree_flatten(like)
     out = []
     for i, ref in enumerate(leaves):

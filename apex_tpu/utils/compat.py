@@ -1,0 +1,41 @@
+"""Version compatibility shims for the jax API surface (non-Pallas).
+
+jax moved ``shard_map`` out of ``jax.experimental`` (``from jax import
+shard_map``) and renamed its replication-check kwarg ``check_rep`` →
+``check_vma`` in the same breath. Call sites across the package, tools,
+and tests use the new spelling; this shim resolves whichever the installed
+jax provides and translates the kwarg, so the whole distributed surface
+imports — and the parallel test tier collects — on either side of the
+move. (The Pallas-side twin lives in :mod:`apex_tpu.ops.pallas._compat`.)
+"""
+
+from __future__ import annotations
+
+try:  # new location: jax >= 0.6
+    from jax import shard_map as _shard_map
+    _OLD_KWARG = False
+except ImportError:  # old location, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _OLD_KWARG = True
+
+
+def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the new-style ``check_vma`` kwarg on any jax."""
+    if _OLD_KWARG and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map(f, **kwargs)
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` on any jax.
+
+    Older jax has no ``lax.axis_size``; ``lax.psum(1, name)`` is the
+    classic spelling and constant-folds to a static Python int under
+    shard_map (axis sizes are known at trace time), which is what every
+    caller here needs (reshape dims, ppermute tables).
+    """
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

@@ -43,6 +43,22 @@ def one_time_warning(msg: str) -> None:
     print(f"Warning: {msg}", file=sys.stderr)
 
 
+def structured_warning(event: str, stream=None, **fields) -> Dict[str, Any]:
+    """Emit a machine-parseable warning record (one JSON line to stderr).
+
+    The resilience subsystem reports degraded-mode transitions through this
+    — checkpoint skipped as corrupt, save retry, preemption requested,
+    loss-scale growth frozen — so a log pipeline can alert on ``event``
+    instead of scraping free-text warnings. Returns the record (tests
+    assert on it). Device scalars in ``fields`` are coerced to floats.
+    """
+    rec: Dict[str, Any] = {"level": "warning", "event": event}
+    rec.update(fields)
+    print(json.dumps(rec, sort_keys=True, default=float),
+          file=stream or sys.stderr, flush=True)
+    return rec
+
+
 class AverageMeter:
     """Running average (examples/imagenet/main_amp.py AverageMeter)."""
 

@@ -432,6 +432,7 @@ def check_remote_copy(jax, jnp):
 
     from apex_tpu.ops.pallas.remote_copy import (halo_exchange_rdma,
                                                  peer_shift)
+    from apex_tpu.utils.compat import shard_map
 
     mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
     x = jax.random.normal(jax.random.PRNGKey(0), (16, 256), jnp.float32)
@@ -441,7 +442,7 @@ def check_remote_copy(jax, jnp):
         lo, hi = halo_exchange_rdma(x, "x", 2)
         return y, lo, hi
 
-    y, lo, hi = jax.jit(jax.shard_map(
+    y, lo, hi = jax.jit(shard_map(
         body, mesh=mesh, in_specs=P("x"), out_specs=(P("x"), P("x"),
                                                      P("x")),
         check_vma=False))(x)
@@ -460,7 +461,7 @@ def check_remote_copy(jax, jnp):
     def body_pool(x, lo_in, hi_in):
         return halo_exchange_rdma(x, "x", 2, bufs=(lo_in, hi_in))
 
-    lo2, hi2 = jax.jit(jax.shard_map(
+    lo2, hi2 = jax.jit(shard_map(
         body_pool, mesh=mesh, in_specs=(P("x"), P("x"), P("x")),
         out_specs=(P("x"), P("x")), check_vma=False))(x, *bufs)
     e4, ok4 = _cmp(lo2, jnp.zeros_like(lo2), 0.0)

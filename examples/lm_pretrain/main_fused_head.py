@@ -8,6 +8,11 @@ logits never materialize in HBM — updated by FusedAdam.
 Run (CPU or TPU):
     JAX_PLATFORMS=cpu python examples/lm_pretrain/main_fused_head.py \
         --steps 4 --vocab-chunk 256
+
+With ``--ckpt-dir`` the loop becomes preemptible: it resumes from the
+newest valid checkpoint, saves every ``--save-every`` steps through the
+atomic CheckpointManager, and a SIGTERM/SIGINT triggers one final
+synchronous save before exit (docs/robustness.md).
 """
 
 from __future__ import annotations
@@ -30,6 +35,9 @@ def main():
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--vocab-chunk", type=int, default=256)
     ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--ckpt-dir", type=str, default=None,
+                    help="enable resumable checkpointing into this dir")
+    ap.add_argument("--save-every", type=int, default=2)
     args = ap.parse_args()
 
     from apex_tpu.models.gpt2 import GPT2, GPT2Config
@@ -66,14 +74,50 @@ def main():
     def grads_of(params):
         return jax.value_and_grad(loss_fn)(params)
 
+    # optional resilience: resumable atomic checkpoints + preemption guard
+    manager = guard = None
+    start_step = 0
+    if args.ckpt_dir:
+        import numpy as np
+
+        from apex_tpu.resilience import CheckpointManager, PreemptionGuard
+        manager = CheckpointManager(args.ckpt_dir, max_to_keep=2)
+        guard = PreemptionGuard().install()
+        like = {"params": params, "opt": opt.state_dict(), "step": 0}
+        restored = manager.restore_latest(like)
+        if restored is not None:
+            _, tree = restored
+            params = tree["params"]
+            opt.load_state_dict(jax.tree_util.tree_map(np.asarray,
+                                                       tree["opt"]))
+            start_step = int(tree["step"]) + 1
+            print(f"resumed from step {start_step - 1}", flush=True)
+
+    def save(step, params):
+        manager.save(step, {"params": params, "opt": opt.state_dict(),
+                            "step": step})
+
     l0 = loss = None
-    for step in range(args.steps):
-        loss, grads = grads_of(params)
-        params = opt.step(grads)
-        if l0 is None:
-            l0 = float(loss)
-        print(f"step {step}: loss {float(loss):.4f}", flush=True)
-    if args.steps >= 2:
+    try:
+        for step in range(start_step, args.steps):
+            loss, grads = grads_of(params)
+            params = opt.step(grads)
+            if l0 is None:
+                l0 = float(loss)
+            print(f"step {step}: loss {float(loss):.4f}", flush=True)
+            if manager is not None and step % args.save_every == 0:
+                save(step, params)
+            if guard is not None and guard.should_stop():
+                save(step, params)  # final synchronous save, then stop
+                print(f"preempted: saved step {step}, exiting", flush=True)
+                return
+    finally:
+        if guard is not None:
+            guard.restore()
+    # l0 is the first loss seen by THIS process — only meaningful to
+    # compare once we have run at least two steps since (a resumed run may
+    # have had a single step left)
+    if args.steps - start_step >= 2 and loss is not None:
         assert float(loss) < l0, "loss did not fall"
         print(f"OK: fused-head LM loss fell {l0:.4f} -> {float(loss):.4f}")
 

@@ -25,3 +25,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+
+def pytest_configure(config):
+    # fault-injection suite (tests/test_resilience.py): deterministic,
+    # CPU-only, fast — runs in tier-1; select alone with `-m fault`
+    config.addinivalue_line(
+        "markers",
+        "fault: deterministic fault-injection resilience tests "
+        "(fast, CPU-only, tier-1)")

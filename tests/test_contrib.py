@@ -10,7 +10,7 @@ import numpy as np
 import pytest
 import torch
 
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.contrib.clip_grad import clip_grad_norm_
@@ -346,6 +346,7 @@ class TestASPFlatOptimizers:
 
 
 class TestSpatialBottleneck:
+    @pytest.mark.slow
     def test_matches_unsharded_bottleneck(self):
         """H-sharded SpatialBottleneck == Bottleneck on the full input
         (the reference's spatial-parallel correctness property)."""

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import axis_size, shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models.gpt2 import GPT2Config
@@ -38,8 +38,8 @@ def _grads_on_mesh(params, data, dp, tp, sp):
     def local(params, tokens, targets, mask):
         grads = jax.grad(
             lambda p: _forward_local(CFG, p, tokens, targets, mask))(params)
-        n_total = (jax.lax.axis_size("dp") * jax.lax.axis_size("tp")
-                   * jax.lax.axis_size("sp"))
+        n_total = (axis_size("dp") * axis_size("tp")
+                   * axis_size("sp"))
 
         def sync(g, axes):
             for ax in axes.split("|"):
@@ -55,8 +55,13 @@ def _grads_on_mesh(params, data, dp, tp, sp):
     return jax.jit(f)(params, *data)
 
 
-@pytest.mark.parametrize("shape", [(2, 1, 1), (1, 2, 1), (1, 1, 2),
-                                   (2, 2, 2)])
+# tier-1 runs the all-axes (2,2,2) cell (dp+tp+sp parity at once); the
+# single-axis cells stay in the slow tier
+@pytest.mark.parametrize("shape", [
+    pytest.param((2, 1, 1), marks=pytest.mark.slow),
+    pytest.param((1, 2, 1), marks=pytest.mark.slow),
+    pytest.param((1, 1, 2), marks=pytest.mark.slow),
+    (2, 2, 2)])
 def test_parallel_grads_match_single_device(shape):
     params = init_params(CFG, jax.random.PRNGKey(0))
     data = _data()
@@ -71,6 +76,7 @@ def test_parallel_grads_match_single_device(shape):
                                    atol=3e-3, rtol=0.05)
 
 
+@pytest.mark.slow
 def test_train_step_descends_on_mesh():
     params = init_params(CFG, jax.random.PRNGKey(0))
     opt_state = init_opt_state(params)
@@ -174,6 +180,7 @@ class TestPipelineComposed:
         assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_ulysses_strategy_matches_ring():
     """The composed dp×tp×sp step with sp_strategy='ulysses' computes the
     same loss trajectory as the ring strategy (same math, different comm).

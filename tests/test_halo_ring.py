@@ -9,7 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.parallel import (HaloExchangerAllGather, HaloExchangerNoComm,
@@ -151,6 +151,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_zigzag_differentiable(self, mesh):
         q, k, v = self._qkv(seed=5)
         qz, kz, vz = (zigzag_shard(t, WORLD) for t in (q, k, v))
@@ -174,6 +175,7 @@ class TestRingAttention:
                 np.asarray(zigzag_unshard(g, WORLD)), np.asarray(r),
                 atol=5e-4, rtol=5e-4, err_msg=f"d{name}")
 
+    @pytest.mark.slow
     def test_differentiable(self, mesh):
         q, k, v = self._qkv(seed=1)
 
@@ -217,7 +219,7 @@ class TestUlysses:
                    for k_ in ks)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+            shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
             out_specs=P(None, None, "sp"), check_vma=False)
         def sharded(q, k, v):
             return ulysses_self_attention(q, k, v, "sp", causal)
@@ -227,6 +229,7 @@ class TestUlysses:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5)
 
+    @pytest.mark.slow
     def test_grad_matches_full_attention(self):
         import functools
         from jax.sharding import PartitionSpec as P
@@ -241,7 +244,7 @@ class TestUlysses:
                    for k_ in ks)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+            shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
             out_specs=P(), check_vma=False)
         def loss_sharded(q, k, v):
             o = ulysses_self_attention(q, k, v, "sp", True)
@@ -265,7 +268,7 @@ class TestUlysses:
         q = jnp.zeros((1, n - 1, n * 8, 64))
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
+            shard_map, mesh=mesh, in_specs=P(None, None, "sp"),
             out_specs=P(None, None, "sp"), check_vma=False)
         def sharded(q):
             return ulysses_self_attention(q, q, q, "sp", False)
@@ -274,10 +277,15 @@ class TestUlysses:
             sharded(q)
 
 
+@pytest.mark.slow
 class TestRdmaTransport:
     """Pallas remote-DMA peer transport (ops/pallas/remote_copy) vs the
     ppermute collective path — both must produce identical halos (the
-    peer_memory push_pull_halos_1d capability, peer_memory.cpp:20-34)."""
+    peer_memory push_pull_halos_1d capability, peer_memory.cpp:20-34).
+
+    slow: interpret-mode RDMA emulation dominates tier-1 wall clock; the
+    ppermute-collective equivalents above keep the semantics covered in the
+    fast tier."""
 
     def test_peer_shift_matches_ppermute(self, mesh):
         from apex_tpu.ops.pallas.remote_copy import peer_shift

@@ -15,9 +15,15 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# slow: a full kernel-zoo AOT compile is a minutes-scale subprocess — far
+# the heaviest single test — and belongs with the other long-running
+# integration checks, not the fast CPU tier
+@pytest.mark.slow
 def test_kernel_zoo_compiles_for_v5e(tmp_path):
     env = dict(os.environ)
     kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)

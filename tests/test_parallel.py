@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 
 from apex_tpu.parallel import (DistributedDataParallel, SyncBatchNorm,
                                bucketed_allreduce, get_mesh,
@@ -328,7 +328,7 @@ class TestMeshLayer:
         n = len(jax.devices())
         mesh = make_hybrid_mesh([2], [n // 2], ["dp", "tp"])
 
-        @functools.partial(jax.shard_map, mesh=mesh,
+        @functools.partial(shard_map, mesh=mesh,
                            in_specs=P("dp", "tp"), out_specs=P(),
                            check_vma=False)
         def total(x):

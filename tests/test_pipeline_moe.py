@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.parallel import make_mesh
@@ -48,6 +48,7 @@ class TestPipeline:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_backward_matches_sequential(self):
         p, m = 4, 8
         mesh = make_mesh([p], ["pp"])
@@ -121,6 +122,7 @@ class TestMoE:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.slow
     def test_ep_differentiable(self):
         ep, e, d, h, t = 2, 4, 8, 16, 32
         mesh = make_mesh([ep], ["ep"])

@@ -1,0 +1,421 @@
+"""Resilience subsystem: fault-injection tests (marker: ``fault``).
+
+Every production failure the subsystem claims to survive is reproduced
+here deterministically: torn writes and manifest corruption (restore_latest
+recovers the newest good step bit-identically), transient EIO (retry with
+backoff), preemption signals (save-and-stop through PreemptionGuard), and
+NaN/Inf overflow storms (the scale never collapses below the floor).
+"""
+
+import errno
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp.grad_scaler import DynamicGradScaler
+from apex_tpu.resilience import (CheckpointCorruptError, CheckpointError,
+                                 CheckpointManager, FaultInjector,
+                                 PreemptionGuard, SimulatedCrash,
+                                 resilient_step, skip_on_overflow)
+from apex_tpu.utils.logging import structured_warning
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tree(seed: float = 0.0):
+    return {"w": jnp.arange(16.0).reshape(4, 4) + seed,
+            "b": jnp.ones((8,), jnp.bfloat16) * (1.0 + seed),
+            "step": jnp.int32(seed)}
+
+
+def _assert_tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------- manager
+
+def test_roundtrip_bit_identical_and_retention(tmp_path):
+    m = CheckpointManager(str(tmp_path), max_to_keep=2)
+    trees = {s: _tree(s) for s in (1, 2, 3)}
+    for s, t in trees.items():
+        m.save(s, t)
+    assert m.all_steps() == [2, 3]  # step 1 rotated out
+    step, back = m.restore_latest(_tree())
+    assert step == 3
+    _assert_tree_equal(back, trees[3])
+
+
+def test_restore_latest_empty_dir(tmp_path):
+    m = CheckpointManager(str(tmp_path))
+    assert m.restore_latest(_tree()) is None
+    assert m.latest_step() is None
+
+
+@pytest.mark.fault
+def test_torn_write_mid_save_is_invisible(tmp_path):
+    """A crash mid-save (torn leaf write) leaves only an uncommitted .tmp:
+    restore_latest still returns the previous step, bit-identical, and the
+    next successful save garbage-collects the staging dir."""
+    good = _tree(1)
+    CheckpointManager(str(tmp_path)).save(1, good)
+
+    inj = FaultInjector(seed=7).torn_write(2, fraction=0.3)
+    m = CheckpointManager(str(tmp_path), fs=inj.filesystem(), retries=0)
+    with pytest.raises(SimulatedCrash):
+        m.save(2, _tree(2))
+    assert any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+    assert m.all_steps() == [1]
+
+    step, back = m.restore_latest(_tree())
+    assert step == 1
+    _assert_tree_equal(back, good)
+
+    m.save(3, _tree(3))  # recovery save prunes the stale .tmp
+    assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+
+
+@pytest.mark.fault
+def test_kill_mid_save_plus_manifest_corruption_resumes_bit_identical(
+        tmp_path, capsys):
+    """Acceptance: kill mid-save of the newest step AND corrupt the newest
+    committed manifest — restore_latest recovers the newest valid step with
+    bit-identical state and training resumes from it."""
+    m0 = CheckpointManager(str(tmp_path), max_to_keep=None)
+    params = {"w": jnp.full((4, 4), 0.5), "m": jnp.zeros((4, 4))}
+
+    @jax.jit
+    def train_step(p):
+        return jax.tree_util.tree_map(lambda x: x * 1.5 + 0.25, p)
+
+    history = {}
+    for step in range(1, 3):
+        params = train_step(params)
+        history[step] = params
+        m0.save(step, params)
+
+    # the process "dies" partway through saving step 3
+    inj = FaultInjector(seed=3).torn_write(1, fraction=0.6)
+    killed = CheckpointManager(str(tmp_path), fs=inj.filesystem(), retries=0)
+    with pytest.raises(SimulatedCrash):
+        killed.save(3, train_step(params))
+    # ... and the newest *committed* checkpoint rots on disk
+    manifest = os.path.join(m0.step_path(2), "manifest.json")
+    raw = open(manifest, "rb").read()
+    open(manifest, "wb").write(raw[:len(raw) // 2])
+
+    # a fresh process resumes
+    m1 = CheckpointManager(str(tmp_path))
+    restored = m1.restore_latest(jax.tree_util.tree_map(
+        lambda x: jnp.zeros_like(x), params))
+    assert restored is not None
+    step, state = restored
+    assert step == 1
+    _assert_tree_equal(state, history[1])  # bit-identical
+    err = capsys.readouterr().err
+    assert "checkpoint_skipped_corrupt" in err
+
+    # training continues from the recovered state and recomputes step 2
+    recomputed = train_step(state)
+    _assert_tree_equal(recomputed, history[2])
+
+
+@pytest.mark.fault
+def test_corrupt_leaf_checksum_detected(tmp_path):
+    m = CheckpointManager(str(tmp_path))
+    m.save(1, _tree(1))
+    leaf = os.path.join(m.step_path(1), "leaf_00000.npy")
+    data = bytearray(open(leaf, "rb").read())
+    data[-1] ^= 0xFF  # same length, different bytes: only the CRC sees it
+    open(leaf, "wb").write(bytes(data))
+    with pytest.raises(CheckpointCorruptError, match="checksum"):
+        m.restore(1, _tree())
+    assert m.restore_latest(_tree()) is None
+
+
+@pytest.mark.fault
+def test_transient_eio_retries_with_backoff(tmp_path, capsys):
+    sleeps = []
+    inj = FaultInjector().fail_write(1, err=errno.EIO, count=2)
+    m = CheckpointManager(str(tmp_path), fs=inj.filesystem(), retries=3,
+                          backoff_base=0.05, sleep=sleeps.append)
+    m.save(5, _tree(5))
+    assert m.all_steps() == [5]
+    assert sleeps == [0.05, 0.1]  # exponential backoff, injected sleep
+    assert capsys.readouterr().err.count("checkpoint_save_retry") == 2
+    step, back = m.restore_latest(_tree())
+    assert step == 5
+    _assert_tree_equal(back, _tree(5))
+
+
+@pytest.mark.fault
+def test_retry_exhaustion_raises_checkpoint_error(tmp_path):
+    inj = FaultInjector().fail_write(1, err=errno.ENOSPC, count=50)
+    m = CheckpointManager(str(tmp_path), fs=inj.filesystem(), retries=2,
+                          sleep=lambda s: None)
+    with pytest.raises(CheckpointError, match="after 3 attempts"):
+        m.save(1, _tree())
+    assert m.all_steps() == []
+
+
+# ------------------------------------------------------------- preemption
+
+@pytest.mark.fault
+def test_preemption_signal_saves_and_stops(tmp_path):
+    m = CheckpointManager(str(tmp_path))
+    inj = FaultInjector()
+    params = _tree(0)
+    saved_at = []
+    with PreemptionGuard() as guard:
+        for step in range(100):
+            params = jax.tree_util.tree_map(lambda x: x, params)
+            if step == 3:
+                inj.fire_preemption(signal.SIGTERM)
+            if guard.should_stop():
+                m.save(step, params)
+                saved_at.append(step)
+                break
+    assert saved_at == [3]
+    assert guard.received_signal == signal.SIGTERM
+    assert m.all_steps() == [3]
+    # handlers restored after the with-block
+    assert signal.getsignal(signal.SIGTERM) not in (guard._handler,)
+
+
+@pytest.mark.fault
+def test_resave_same_step_is_crash_safe(tmp_path):
+    """Re-saving an existing step never deletes the old commit before the
+    new one lands: a crash while staging the re-save leaves the original
+    restorable, and a successful re-save replaces content with no
+    .old/.tmp debris."""
+    m = CheckpointManager(str(tmp_path))
+    first = _tree(1)
+    m.save(1, first)
+
+    inj = FaultInjector().torn_write(1, fraction=0.5)
+    crashy = CheckpointManager(str(tmp_path), fs=inj.filesystem(), retries=0)
+    with pytest.raises(SimulatedCrash):
+        crashy.save(1, _tree(9))  # dies staging the re-save
+    step, back = m.restore_latest(_tree())
+    assert step == 1
+    _assert_tree_equal(back, first)
+
+    second = _tree(5)
+    m.save(1, second)  # successful re-save replaces the content
+    step, back = m.restore_latest(_tree())
+    assert step == 1
+    _assert_tree_equal(back, second)
+    assert not any(n.endswith((".tmp", ".old")) for n in os.listdir(tmp_path))
+
+
+def test_preemption_raise_on_signal_unwinds_and_finalizes(tmp_path):
+    """raise_on_signal: straight-line work (no step loop) unwinds at the
+    signal, on_preempt still runs once, and the with-block exits cleanly."""
+    m = CheckpointManager(str(tmp_path))
+    state = _tree(4)
+    reached_end = False
+    with PreemptionGuard(on_preempt=lambda: m.save(7, state),
+                         raise_on_signal=True) as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        reached_end = True  # never reached: the handler raises
+    assert not reached_end
+    assert guard.should_stop()
+    assert m.all_steps() == [7]
+    _assert_tree_equal(m.restore(7, _tree()), state)
+
+
+def test_preemption_finalize_runs_once():
+    calls = []
+    guard = PreemptionGuard(on_preempt=lambda: calls.append(1))
+    with guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert guard.should_stop()
+    assert calls == [1]  # __exit__ ran the final save
+    assert guard.finalize() is False  # idempotent
+    assert calls == [1]
+
+
+# ---------------------------------------------------------- overflow storm
+
+@pytest.mark.fault
+def test_overflow_storm_never_collapses_scale(capsys):
+    """30-step NaN/Inf burst: every bad step is skipped (params frozen),
+    the scale never goes below the floor, degraded mode announces itself
+    once, and training resumes when gradients are finite again."""
+    inj = FaultInjector(seed=11).nan_burst(start=2, length=30)
+    scaler = DynamicGradScaler(init_scale=2.0 ** 10, growth_interval=4)
+
+    def step_fn(params, sstate, grads):
+        found_inf = jnp.any(jnp.stack([
+            jnp.any(~jnp.isfinite(g))
+            for g in jax.tree_util.tree_leaves(grads)]))
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+        return new, found_inf, jnp.float32(0.0)
+
+    step = resilient_step(step_fn, scaler, max_consecutive_overflows=4)
+    assert scaler.min_scale is None  # caller's scaler is never mutated
+    params = {"w": jnp.ones((4,))}
+    sstate = scaler.init()
+    clean_grads = {"w": jnp.full((4,), 0.5)}
+
+    for i in range(40):
+        grads = inj.poison_grads(clean_grads, i)
+        before = params
+        params, sstate, found_inf, _loss = step(params, sstate, grads)
+        assert float(sstate.scale) >= step.scale_floor
+        if inj.grads_faulty(i):
+            _assert_tree_equal(params, before)  # bad step skipped
+    assert step.degraded and step.skipped_steps == 30
+    assert bool(jnp.all(jnp.isfinite(params["w"])))
+    # params moved once the storm passed
+    assert float(params["w"][0]) != 1.0
+
+    err = capsys.readouterr().err
+    assert err.count('"event": "overflow_storm"') == 1
+
+    step.reset_degraded()
+    assert not step.degraded and step.consecutive_overflows == 0
+
+
+def test_skip_on_overflow_is_jittable():
+    @jax.jit
+    def f(new, old, bad):
+        return skip_on_overflow(new, old, bad)
+
+    new, old = {"a": jnp.ones((3,))}, {"a": jnp.zeros((3,))}
+    np.testing.assert_array_equal(
+        np.asarray(f(new, old, jnp.bool_(True))["a"]), np.zeros((3,)))
+    np.testing.assert_array_equal(
+        np.asarray(f(new, old, jnp.bool_(False))["a"]), np.ones((3,)))
+
+
+def test_scaler_min_scale_and_freeze_growth():
+    scaler = DynamicGradScaler(init_scale=4.0, growth_interval=1,
+                               min_scale=2.0)
+    state = scaler.init()
+    state = scaler.update(state, jnp.bool_(True))   # 4 -> 2
+    assert float(state.scale) == 2.0
+    state = scaler.update(state, jnp.bool_(True))   # clamped at floor
+    assert float(state.scale) == 2.0
+    frozen = scaler.update(state, jnp.bool_(False), freeze_growth=True)
+    assert float(frozen.scale) == 2.0               # growth suppressed
+    grown = scaler.update(state, jnp.bool_(False))
+    assert float(grown.scale) == 4.0                # normal growth works
+
+
+# ------------------------------------------------- utils.checkpoint fixes
+
+def test_save_numpy_atomic_no_tmp_left(tmp_path):
+    from apex_tpu.utils.checkpoint import restore_numpy, save_numpy
+    tree = {"a": jnp.arange(6.0)}
+    path = str(tmp_path / "ck")
+    save_numpy(path, tree)
+    assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+    _assert_tree_equal(restore_numpy(path, tree), tree)
+
+
+@pytest.mark.fault
+def test_save_numpy_crash_mid_write_preserves_previous(tmp_path,
+                                                       monkeypatch):
+    from apex_tpu.utils import checkpoint as ckpt
+    tree = {"a": jnp.arange(6.0)}
+    path = str(tmp_path / "ck")
+    ckpt.save_numpy(path, tree)
+
+    def boom(f, **kw):
+        f.write(b"partial")
+        raise SimulatedCrash("died mid-savez")
+
+    monkeypatch.setattr(ckpt.np, "savez", boom)
+    with pytest.raises(SimulatedCrash):
+        ckpt.save_numpy(path, {"a": jnp.zeros((6,))})
+    # the committed checkpoint is untouched by the torn staging write
+    _assert_tree_equal(ckpt.restore_numpy(path, tree), tree)
+
+
+def test_restore_numpy_accepts_both_spellings(tmp_path):
+    from apex_tpu.utils.checkpoint import restore_numpy, save_numpy
+    tree = {"a": jnp.arange(4.0)}
+    base = str(tmp_path / "ck")
+    save_numpy(base, tree)
+    _assert_tree_equal(restore_numpy(base, tree), tree)
+    _assert_tree_equal(restore_numpy(base + ".npz", tree), tree)
+
+
+def test_restore_numpy_missing_names_candidates(tmp_path):
+    from apex_tpu.utils.checkpoint import restore_numpy
+    base = str(tmp_path / "nope")
+    with pytest.raises(FileNotFoundError) as ei:
+        restore_numpy(base, {"a": jnp.zeros(1)})
+    assert "nope" in str(ei.value) and "nope.npz" in str(ei.value)
+
+
+def test_async_save_handle_surfaces_writer_failure():
+    from apex_tpu.utils.checkpoint import AsyncSaveHandle
+
+    class FailingCkptr:
+        closed = False
+
+        def wait_until_finished(self):
+            raise IOError("disk full in background writer")
+
+        def close(self):
+            self.closed = True
+
+    ckptr = FailingCkptr()
+    h = AsyncSaveHandle(ckptr, "/ckpt/step_7")
+    with pytest.raises(RuntimeError, match=r"/ckpt/step_7.*disk full"):
+        h.wait()
+    assert ckptr.closed
+    # a failed save must never later read as durable: every wait() re-raises
+    with pytest.raises(RuntimeError, match=r"/ckpt/step_7.*disk full"):
+        h.wait()
+
+
+# ----------------------------------------------------- logging + tooling
+
+def test_structured_warning_record_and_json():
+    buf = io.StringIO()
+    rec = structured_warning("unit_test_event", stream=buf, value=3,
+                             scale=jnp.float32(2.0))
+    assert rec["event"] == "unit_test_event" and rec["level"] == "warning"
+    parsed = json.loads(buf.getvalue())
+    assert parsed["value"] == 3 and parsed["scale"] == 2.0
+
+
+@pytest.mark.fault
+def test_check_durability_tool_clean_and_catches_violation(tmp_path):
+    r = subprocess.run([sys.executable,
+                        os.path.join(ROOT, "tools", "check_durability.py")],
+                       capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        from check_durability import _check_file
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad_checkpoint.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "def save_checkpoint(path, arr):\n"
+        "    np.savez(path, arr=arr)\n")
+    assert _check_file(str(bad)), "non-atomic checkpoint write not flagged"
+    good = tmp_path / "good_checkpoint.py"
+    good.write_text(
+        "import numpy as np, os\n"
+        "def save_checkpoint(path, arr):\n"
+        "    with open(path + '.tmp', 'wb') as f:\n"
+        "        np.savez(f, arr=arr)\n"
+        "    os.replace(path + '.tmp', path)\n")
+    assert not _check_file(str(good))

@@ -11,9 +11,15 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# slow: compiles the entire distributed stack AOT in a subprocess — a
+# minutes-scale job that belongs with the long-running integration checks,
+# not the fast CPU tier
+@pytest.mark.slow
 def test_distributed_stack_compiles_for_v5e(tmp_path):
     env = dict(os.environ)
     kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)

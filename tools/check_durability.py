@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Static durability check: no non-atomic writes on checkpoint paths.
+
+A checkpoint written with a bare ``open(path, "w")`` / ``np.savez(path)``
+can be torn by a crash and then loaded (or choked on) at restore — the
+exact failure class ``apex_tpu.resilience`` exists to close. This check
+greps the package AST for write calls in checkpoint-flavored code and
+fails unless the enclosing function shows the atomic-commit discipline:
+stage to ``.tmp`` + publish with ``os.replace``, or route through the
+``Filesystem.write_bytes`` seam (whose sole implementation follows it),
+or write only to an in-memory buffer.
+
+Scope (kept deliberately narrow to stay false-positive-free):
+- files whose path contains ``checkpoint``, and
+- functions whose name contains save/checkpoint/ckpt/manifest anywhere in
+  ``apex_tpu/``.
+
+Exit status: 0 clean, 1 on violations (listed one per line). Run as
+``python tools/check_durability.py`` from the repo root; the tier-1 suite
+runs it (tests/test_resilience.py) so new violations fail CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(ROOT, "apex_tpu")
+
+CKPT_NAME_HINTS = ("save", "checkpoint", "ckpt", "manifest")
+WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb")
+# evidence of the atomic-commit discipline inside a function's source
+SAFE_MARKERS = (".tmp", "os.replace")
+# writes through these are safe by construction (in-memory, or the fs seam)
+SAFE_CALL_HINTS = ("BytesIO", "write_bytes", "StringIO")
+ALLOWED_FUNCS = {"write_bytes"}  # the seam's own implementation
+
+
+def _is_write_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("save", "savez",
+                                                   "savez_compressed"):
+        root = f.value
+        if isinstance(root, ast.Name) and root.id in ("np", "numpy"):
+            return True
+    if isinstance(f, ast.Name) and f.id == "open":
+        mode = None
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and mode in WRITE_MODES
+    return False
+
+
+def _check_file(path: str) -> List[Tuple[int, str]]:
+    src = open(path).read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"unparseable: {e.msg}")]
+    ckpt_file = "checkpoint" in os.path.basename(path).lower()
+    lines = src.splitlines()
+    violations: List[Tuple[int, str]] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: List[ast.AST] = []
+
+        def visit_FunctionDef(self, node):
+            self.stack.append(node)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            if _is_write_call(node):
+                fn = self.stack[-1] if self.stack else None
+                name = fn.name if fn is not None else "<module>"
+                in_scope = ckpt_file or any(
+                    h in name.lower() for h in CKPT_NAME_HINTS)
+                if in_scope and name not in ALLOWED_FUNCS:
+                    seg = ("\n".join(
+                        lines[fn.lineno - 1:fn.end_lineno])
+                        if fn is not None else src)
+                    safe = (all(m in seg for m in SAFE_MARKERS)
+                            or any(h in seg for h in SAFE_CALL_HINTS))
+                    if not safe:
+                        violations.append((
+                            node.lineno,
+                            f"{name}: non-atomic write on a checkpoint "
+                            f"path (want .tmp + os.replace, or the "
+                            f"Filesystem.write_bytes seam)"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return violations
+
+
+def main() -> int:
+    bad = []
+    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            for lineno, msg in _check_file(path):
+                bad.append(f"{os.path.relpath(path, ROOT)}:{lineno}: {msg}")
+    if bad:
+        print("durability check FAILED:", file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    print("durability check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

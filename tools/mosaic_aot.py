@@ -40,6 +40,8 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 from jax.sharding import Mesh, NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from apex_tpu.utils.compat import shard_map  # noqa: E402
 from jax.sharding import SingleDeviceSharding  # noqa: E402
 
 OUT_PATH = os.environ.get("MOSAIC_AOT_OUT",
@@ -115,7 +117,7 @@ def build_cases(dev_sharding, mesh):
     add("fused_adagrad_flat", "1b",
         lambda p, g, h: fused_adagrad_flat(p, g, h, lr=1e-2,
                                            weight_decay=1e-4),
-        pf, gb.update(dtype=jnp.float32), mf)
+        pf, _struct(gb.shape, jnp.float32, s), mf)
 
     # ---- LayerNorm / RMSNorm at the bench shape (8192x4096 bf16) -------
     from apex_tpu.normalization.fused_layer_norm import (
@@ -209,7 +211,7 @@ def build_cases(dev_sharding, mesh):
         return y, lo, hi
 
     add("remote_copy", "ring4_shift_halo",
-        lambda x: jax.shard_map(rdma_body, mesh=mesh, in_specs=P("x"),
+        lambda x: shard_map(rdma_body, mesh=mesh, in_specs=P("x"),
                                 out_specs=(P("x"), P("x"), P("x")),
                                 check_vma=False)(x), xr)
 
@@ -225,7 +227,7 @@ def build_cases(dev_sharding, mesh):
         return halo_exchange_rdma(x, "x", 2, bufs=(lo_in, hi_in))
 
     add("remote_copy", "ring4_halo_pool_bufs",
-        lambda x, lo, hi: jax.shard_map(
+        lambda x, lo, hi: shard_map(
             rdma_pool_body, mesh=mesh, in_specs=(P("x"), P("x"), P("x")),
             out_specs=(P("x"), P("x")), check_vma=False)(x, lo, hi),
         xr, buf, buf)
@@ -237,7 +239,7 @@ def build_cases(dev_sharding, mesh):
     qr = _struct((1, 8, nring * 1024, 64), jnp.bfloat16,
                  NamedSharding(mesh, P(None, None, "x", None)))
     add("ring_attention", f"collective_{nring}dev",
-        lambda q, k, v: jax.shard_map(
+        lambda q, k, v: shard_map(
             lambda q, k, v: ring_attention(q, k, v, axis_name="x"),
             mesh=mesh,
             in_specs=P(None, None, "x", None),

@@ -36,6 +36,8 @@ from jax.experimental import topologies  # noqa: E402
 from jax.sharding import Mesh, NamedSharding  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
+from apex_tpu.utils.compat import shard_map  # noqa: E402
+
 OUT_PATH = os.environ.get("STACK_AOT_OUT",
                           os.path.join(ROOT, "STACK_AOT.json"))
 
@@ -137,7 +139,7 @@ def compile_ddp_syncbn(mesh4):
     ns = NamedSharding(mesh4, P("data"))
     grads = _gstructs(_params(), ns)
     x = jax.ShapeDtypeStruct((8, 8, 8, 64), jnp.float32, sharding=ns)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         body, mesh=mesh4, in_specs=(P("data"), P("data")),
         out_specs=(P("data"), P(), P(), P()), check_vma=False))
     return fn.lower(grads, x).compile()
@@ -148,7 +150,7 @@ def compile_ulysses(mesh4):
 
     ns = NamedSharding(mesh4, P(None, None, "data", None))
     q = jax.ShapeDtypeStruct((1, 8, 4 * 512, 64), jnp.bfloat16, sharding=ns)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         lambda q, k, v: ulysses_self_attention(q, k, v, "data", True),
         mesh=mesh4, in_specs=P(None, None, "data", None),
         out_specs=P(None, None, "data", None), check_vma=False))
@@ -173,7 +175,7 @@ def compile_ring_long(mesh16, zigzag: bool):
     else:
         body = lambda q, k, v: ring_attention(  # noqa: E731
             q, k, v, axis_name="sp", causal=True)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         body, mesh=mesh16, in_specs=P(None, None, "sp", None),
         out_specs=P(None, None, "sp", None), check_vma=False))
     return fn.lower(q, q, q).compile()
