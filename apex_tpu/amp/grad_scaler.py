@@ -19,7 +19,9 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.multi_tensor.functional import (multi_tensor_scale,
+from apex_tpu.multi_tensor.functional import (multi_tensor_l2norm,
+                                              multi_tensor_scale,
+                                              multi_tensor_unscale_l2norm,
                                               tree_check_finite,
                                               update_scale_hysteresis)
 
@@ -75,6 +77,23 @@ class DynamicGradScaler:
             return grads, jnp.zeros((), jnp.bool_)
         inv = 1.0 / state.scale
         return multi_tensor_scale(grads, inv)
+
+    def unscale_and_norm(self, grads: Any, state: ScalerState
+                         ) -> Tuple[Any, jax.Array, jax.Array]:
+        """Fused unscale + global grad-norm + overflow check in ONE pass
+        over the gradients (ref csrc/amp_C_frontend.cpp:13-28
+        ``multi_tensor_unscale_l2norm``).
+
+        Returns ``(unscaled_grads, grad_norm, found_inf)`` — exactly what
+        :func:`apex_tpu.monitor.metrics.collect_metrics` wants, so metric
+        collection costs nothing beyond the unscale the step already does.
+        """
+        if not self.enabled:
+            gnorm, _ = multi_tensor_l2norm(grads)
+            return grads, gnorm, tree_check_finite(grads)
+        out, gnorm, _, found_inf = multi_tensor_unscale_l2norm(
+            grads, 1.0 / state.scale)
+        return out, gnorm, found_inf
 
     def update(self, state: ScalerState, found_inf,
                freeze_growth: bool = False) -> ScalerState:

@@ -2,6 +2,14 @@
 
 Delegates to the repo-root bench.py when present (the driver's interface),
 else runs the packaged headline benchmark inline.
+
+``apex-tpu-bench --telemetry-jsonl PATH [--steps N]`` instead runs the
+telemetry-instrumented train bench: a single-jit LM train step (amp dynamic
+loss scaling + fused Adam) with in-graph :class:`TrainMetrics`, streamed
+through :class:`apex_tpu.monitor.Telemetry` so every step lands in PATH as
+``{step, loss, grad_norm, loss_scale, step_ms, tokens_per_s, mfu, ...}``.
+Feed the JSONL to ``tools/check_regression.py`` against a committed
+baseline to gate perf claims in CI (docs/observability.md).
 """
 
 from __future__ import annotations
@@ -50,6 +58,96 @@ def _inline_bench() -> None:
         "vs_baseline": round(ref_ms / ms, 3)}))
 
 
+def _make_telemetry_step(batch: int = 8, seq: int = 33, vocab: int = 128,
+                         hidden: int = 64, init_scale: float = 2.0 ** 12):
+    """Build the instrumented LM train step for the telemetry bench.
+
+    Returns ``(step, state, tokens, tokens_per_step)`` where ``step`` is
+    ONE jitted callable — ``step(i, state, tokens) -> (state, metrics)``
+    with ``state = (params, m, v, scaler_state)``. Loss scaling, gradient
+    computation, the fused-Adam update (``found_inf`` no-op flag), the
+    scale state machine, and the full :class:`TrainMetrics` collection all
+    trace into that single call: there is nothing for the host to sync on
+    mid-step, and tests assert no callbacks are traced in.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.amp.grad_scaler import DynamicGradScaler
+    from apex_tpu.monitor.metrics import collect_metrics
+    from apex_tpu.optimizers.functional import adam_update
+
+    scaler = DynamicGradScaler(init_scale=init_scale)
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    params = {
+        "emb": jax.random.normal(keys[0], (vocab, hidden)) * 0.02,
+        "w1": jax.random.normal(keys[1], (hidden, hidden)) * 0.1,
+        "b1": jnp.zeros((hidden,)),
+        "head": jax.random.normal(keys[2], (hidden, vocab)) * 0.02,
+    }
+    zeros = lambda p: jnp.zeros_like(p, jnp.float32)  # noqa: E731
+    state = (params, jax.tree_util.tree_map(zeros, params),
+             jax.tree_util.tree_map(zeros, params), scaler.init())
+    tokens = jax.random.randint(keys[3], (batch, seq), 0, vocab, jnp.int32)
+
+    def step(i, state, tokens):
+        params, m, v, sstate = state
+
+        def loss_fn(p):
+            x = p["emb"][tokens[:, :-1]]
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            logp = jax.nn.log_softmax((h @ p["head"]).astype(jnp.float32))
+            nll = -jnp.take_along_axis(logp, tokens[:, 1:, None], axis=-1)
+            loss = jnp.mean(nll)
+            return scaler.scale(loss, sstate), loss
+
+        (_, loss), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # fused unscale + grad-norm + overflow probe: ONE pass over grads
+        grads, grad_norm, found_inf = scaler.unscale_and_norm(grads, sstate)
+        new_p, m, v = adam_update(params, grads, m, v, step=i + 1, lr=1e-2,
+                                  found_inf=found_inf)
+        tm = collect_metrics(
+            params=new_p,
+            updates=jax.tree_util.tree_map(lambda n, o: n - o, new_p,
+                                           params),
+            scaler_state=sstate, grad_norm=grad_norm, found_inf=found_inf,
+            loss=loss)
+        return (new_p, m, v, scaler.update(sstate, found_inf)), tm
+
+    return jax.jit(step), state, tokens, float(batch * (seq - 1))
+
+
+def _telemetry_bench(jsonl_path: str, steps: int = 8) -> None:
+    """Run the instrumented train loop and stream telemetry to JSONL."""
+    import json
+
+    import jax
+
+    from apex_tpu.monitor import Telemetry
+
+    step, state, tokens, tokens_per_step = _make_telemetry_step()
+    tel = Telemetry(jsonl_path, tokens_per_step=tokens_per_step)
+    tel.calibrate(step, 0, state, tokens)  # MFU numerator: XLA cost model
+    # compile outside the timed window so row 1's step_ms is a step, not
+    # the trace+compile
+    state, tm = step(0, state, tokens)
+    jax.block_until_ready(tm)
+    tel.start()
+    for i in range(1, steps + 1):
+        state, tm = step(i, state, tokens)
+        # the loop's ONE host transfer — the overflow flag it needs anyway;
+        # its data dependency also makes step_ms honest wall clock
+        skipped = bool(jax.device_get(tm.found_inf))
+        tel.log_step(i, metrics=tm, skipped=skipped)
+    tel.close()
+    summary = tel.summary()
+    print(json.dumps({
+        "metric": "telemetry_train_step_ms_lm_tiny",
+        "value": round(summary["metrics"].get("step_ms", -1.0), 3),
+        "unit": "ms", "steps": steps, "jsonl": jsonl_path,
+        "goodput": summary["goodput"]["goodput_frac"]}))
+
+
 def main() -> None:
     # a preempted bench run (SIGTERM from the scheduler) exits cleanly with
     # a structured record instead of a stack trace mid-measurement; there is
@@ -58,13 +156,25 @@ def main() -> None:
     from apex_tpu.utils.logging import structured_warning
 
     with PreemptionGuard(raise_on_signal=True) as guard:
-        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        bench = os.path.join(here, "bench.py")
-        if os.path.exists(bench):
-            sys.argv = [bench] + sys.argv[1:]
-            runpy.run_path(bench, run_name="__main__")
+        if any(a == "--telemetry-jsonl"
+               or a.startswith("--telemetry-jsonl=")
+               for a in sys.argv[1:]):
+            import argparse
+
+            ap = argparse.ArgumentParser(prog="apex-tpu-bench")
+            ap.add_argument("--telemetry-jsonl", required=True)
+            ap.add_argument("--steps", type=int, default=8)
+            args, _ = ap.parse_known_args(sys.argv[1:])
+            _telemetry_bench(args.telemetry_jsonl, args.steps)
         else:
-            _inline_bench()
+            here = os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+            bench = os.path.join(here, "bench.py")
+            if os.path.exists(bench):
+                sys.argv = [bench] + sys.argv[1:]
+                runpy.run_path(bench, run_name="__main__")
+            else:
+                _inline_bench()
     if guard.should_stop():
         structured_warning("bench_preempted",
                            signal=guard.received_signal,

@@ -1,0 +1,31 @@
+"""apex_tpu.monitor — first-class training telemetry.
+
+The observability layer the reference never had (SURVEY §5: ad-hoc NVTX
+ranges and per-example AverageMeters). Three cooperating pieces:
+
+- :mod:`~apex_tpu.monitor.metrics` — jit-safe :class:`TrainMetrics` pytree
+  (grad/param/update norms, overflow flag, loss scale) collected INSIDE the
+  step function with zero extra host syncs.
+- :mod:`~apex_tpu.monitor.telemetry` — the unified :class:`Telemetry` sink:
+  JSONL + console metric rows, mirrored ``structured_warning`` events,
+  trace spans, per-step ``step_ms``/``tokens_per_s``/``mfu`` from the XLA
+  cost model, rank-0 gating on multihost.
+- :mod:`~apex_tpu.monitor.goodput` — :class:`GoodputLedger`: productive vs.
+  lost step-time (overflow skips, checkpoint stalls, preemption), fed by
+  the resilience event stream.
+
+``tools/check_regression.py`` turns the emitted JSONL into a CI gate
+against a committed bench baseline. See docs/observability.md.
+"""
+
+from apex_tpu.monitor.goodput import GoodputLedger  # noqa: F401
+from apex_tpu.monitor.metrics import (  # noqa: F401
+    TrainMetrics, collect_metrics, step_flops, tree_l2norm)
+from apex_tpu.monitor.telemetry import (  # noqa: F401
+    PERF_ROW_KEYS, Telemetry, read_jsonl, validate_row)
+
+__all__ = [
+    "GoodputLedger", "TrainMetrics", "collect_metrics", "step_flops",
+    "tree_l2norm", "PERF_ROW_KEYS", "Telemetry", "read_jsonl",
+    "validate_row",
+]

@@ -1,0 +1,136 @@
+"""Goodput ledger — productive vs. lost wall-clock accounting.
+
+"Goodput" is the fraction of run time spent making forward progress. A
+training run loses time in ways no single component sees end to end:
+overflow-skipped steps (the step ran, the update was discarded), checkpoint
+save/restore stalls, and the unwind after a preemption signal. The ledger
+aggregates all of them in one place:
+
+- **step time** arrives from ``Telemetry.log_step`` (productive, or lost to
+  an overflow skip);
+- **stalls** arrive either from the :meth:`GoodputLedger.stall` context
+  manager around blocking work, or by subscribing to the resilience
+  subsystem's event stream (``checkpoint_save_stall``,
+  ``checkpoint_restore_stall`` records carry ``seconds``) via
+  :func:`apex_tpu.utils.logging.subscribe_events` — no wiring inside the
+  checkpoint code paths needed;
+- **event counts** (``overflow_step_skipped``, ``overflow_storm``,
+  ``preemption_requested``, retries, corrupt-skip) are tallied so the
+  summary explains *why* time was lost.
+
+``summary()`` is what a run report or alert reads:
+``{goodput_frac, productive_s, lost_s, lost_by_cause, steps,
+skipped_steps, events}``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Callable, Dict, Optional
+
+from apex_tpu.utils.logging import subscribe_events
+
+# events whose records carry a ``seconds`` field of lost time
+STALL_EVENTS = {
+    "checkpoint_save_stall": "checkpoint_save",
+    "checkpoint_restore_stall": "checkpoint_restore",
+    "preemption_unwind": "preemption",
+}
+
+# counted (not timed) degradation signals from the resilience subsystem
+COUNTED_EVENTS = (
+    "overflow_step_skipped", "overflow_storm", "overflow_storm_cleared",
+    "checkpoint_save_retry", "checkpoint_skipped_corrupt",
+    "preemption_requested", "bench_preempted",
+)
+
+_OVERFLOW_CAUSE = "overflow_skip"
+
+
+class GoodputLedger:
+    """Accumulate productive vs. lost seconds, by cause.
+
+    ``attach()`` subscribes to the process event bus so resilience stall and
+    degradation events land here automatically; ``detach()`` (or use as a
+    context manager) unsubscribes. Step time is reported explicitly via
+    :meth:`record_step` — by ``Telemetry.log_step`` when a ledger is
+    attached to a telemetry sink.
+    """
+
+    def __init__(self):
+        self.productive_s = 0.0
+        self.lost_by_cause: Dict[str, float] = {}
+        self.steps = 0
+        self.skipped_steps = 0
+        self.events: Dict[str, int] = {}
+        self._unsubscribe: Optional[Callable[[], None]] = None
+
+    # ---- event-bus wiring ----------------------------------------------
+    def attach(self) -> "GoodputLedger":
+        if self._unsubscribe is None:
+            self._unsubscribe = subscribe_events(self.on_event)
+        return self
+
+    def detach(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+
+    def __enter__(self) -> "GoodputLedger":
+        return self.attach()
+
+    def __exit__(self, *exc) -> None:
+        self.detach()
+
+    def on_event(self, rec: Dict[str, Any]) -> None:
+        """Event-bus callback: fold a published record into the ledger."""
+        name = rec.get("event")
+        cause = STALL_EVENTS.get(name)
+        if cause is not None:
+            self.record_stall(cause, float(rec.get("seconds", 0.0)))
+        if name in STALL_EVENTS or name in COUNTED_EVENTS:
+            self.events[name] = self.events.get(name, 0) + 1
+
+    # ---- explicit accounting -------------------------------------------
+    def record_step(self, seconds: float, productive: bool = True,
+                    cause: str = _OVERFLOW_CAUSE) -> None:
+        """One step's wall time: productive, or lost to ``cause``."""
+        self.steps += 1
+        if productive:
+            self.productive_s += seconds
+        else:
+            self.skipped_steps += 1
+            self.record_stall(cause, seconds)
+
+    def record_stall(self, cause: str, seconds: float) -> None:
+        self.lost_by_cause[cause] = (self.lost_by_cause.get(cause, 0.0)
+                                     + seconds)
+
+    @contextlib.contextmanager
+    def stall(self, cause: str):
+        """Time a blocking region (a synchronous save, a restore at boot)
+        as lost time under ``cause``."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record_stall(cause, time.perf_counter() - t0)
+
+    # ---- reporting ------------------------------------------------------
+    @property
+    def lost_s(self) -> float:
+        return sum(self.lost_by_cause.values())
+
+    def summary(self) -> Dict[str, Any]:
+        total = self.productive_s + self.lost_s
+        return {
+            "goodput_frac": (self.productive_s / total) if total > 0 else 1.0,
+            "productive_s": round(self.productive_s, 6),
+            "lost_s": round(self.lost_s, 6),
+            "lost_by_cause": {k: round(v, 6)
+                              for k, v in sorted(self.lost_by_cause.items())},
+            "steps": self.steps,
+            "skipped_steps": self.skipped_steps,
+            "events": dict(sorted(self.events.items())),
+        }

@@ -1,0 +1,104 @@
+"""In-graph training metrics — jit-safe collection with zero extra host syncs.
+
+The reference reports training health from host-side AverageMeters fed by
+``.item()`` calls in the loop (examples/imagenet/main_amp.py) — every metric
+is a blocking device round-trip. Here the metrics are a :class:`TrainMetrics`
+pytree computed INSIDE the jitted step function: the norms fuse into the
+step's existing HBM passes, the result rides out of the jit as device
+scalars, and the host never syncs for them — ``Telemetry``/``MetricLogger``
+batch-fetch the whole buffer at flush time.
+
+Fields not collected are ``None`` (an empty pytree node, so a partially
+filled :class:`TrainMetrics` is still a valid jit carry/return) and are
+simply absent from the emitted JSONL row.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.functional import (multi_tensor_l2norm,
+                                              tree_check_finite)
+
+
+class TrainMetrics(NamedTuple):
+    """Per-step training-health scalars (f32/bool device scalars or None).
+
+    ``found_inf`` doubles as the overflow flag the loop already fetches to
+    count skips, so collecting the rest adds no host traffic.
+    """
+
+    loss: Any = None
+    grad_norm: Any = None
+    param_norm: Any = None
+    update_norm: Any = None
+    found_inf: Any = None
+    loss_scale: Any = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        """Collected fields only (values stay device arrays — no sync)."""
+        return {k: v for k, v in self._asdict().items() if v is not None}
+
+
+def tree_l2norm(tree: Any) -> jax.Array:
+    """Global L2 norm of a pytree (fp32 accumulation, jit-safe)."""
+    return multi_tensor_l2norm(tree)[0]
+
+
+def collect_metrics(grads: Any = None, params: Any = None,
+                    updates: Any = None, scaler_state: Any = None, *,
+                    loss: Any = None, grad_norm: Any = None,
+                    found_inf: Any = None,
+                    loss_scale: Optional[float] = None) -> TrainMetrics:
+    """Build a :class:`TrainMetrics` from whatever the step has in hand.
+
+    Call inside the jitted step function. Everything is pure jnp — no
+    callbacks, no host syncs; tracing this under ``jit`` adds only fused
+    reductions over trees the step already touches.
+
+    - ``grads``/``params``/``updates``: pytrees to norm (any of them may be
+      omitted). Pass precomputed ``grad_norm`` instead of ``grads`` when the
+      unscale pass already produced it
+      (:meth:`~apex_tpu.amp.grad_scaler.DynamicGradScaler.unscale_and_norm`).
+    - ``scaler_state``: an ``amp.ScalerState`` — contributes ``loss_scale``;
+      for unscaled (bf16-first) runs pass ``loss_scale=1.0`` explicitly so
+      the emitted schema stays stable across amp on/off.
+    - ``found_inf``: explicit overflow flag; derived from ``grads`` (or a
+      non-finite ``grad_norm``) when omitted.
+    """
+    if grad_norm is None and grads is not None:
+        grad_norm = tree_l2norm(grads)
+    if found_inf is None:
+        if grads is not None:
+            found_inf = tree_check_finite(grads)
+        elif grad_norm is not None:
+            found_inf = ~jnp.isfinite(jnp.asarray(grad_norm, jnp.float32))
+    scale = None
+    if scaler_state is not None:
+        scale = jnp.asarray(scaler_state.scale, jnp.float32)
+    elif loss_scale is not None:
+        scale = jnp.asarray(loss_scale, jnp.float32)
+    return TrainMetrics(
+        loss=None if loss is None else jnp.asarray(loss, jnp.float32),
+        grad_norm=grad_norm,
+        param_norm=None if params is None else tree_l2norm(params),
+        update_norm=None if updates is None else tree_l2norm(updates),
+        found_inf=found_inf,
+        loss_scale=scale)
+
+
+def step_flops(fn, *args) -> float:
+    """XLA cost-model FLOPs for one call of ``fn(*args)`` — the MFU
+    numerator. ``fn`` may already be jitted (its ``lower`` is reused);
+    otherwise it is jitted for analysis only. Returns 0.0 when the backend
+    reports no cost analysis (interpret-mode CPU paths)."""
+    lower = fn.lower if hasattr(fn, "lower") else jax.jit(fn).lower
+    try:
+        ca = lower(*args).compile().cost_analysis()
+    except Exception:
+        return 0.0
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    return float(ca.get("flops", 0.0))

@@ -46,7 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from apex_tpu.utils.logging import structured_warning
+from apex_tpu.utils.logging import publish_event, structured_warning
 
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
@@ -182,6 +182,7 @@ class CheckpointManager:
         exception — including a simulated crash from the fault harness —
         propagates with the staging dir left uncommitted.
         """
+        t_start = time.perf_counter()
         final = self.step_path(step)
         tmp = final + _TMP_SUFFIX
         leaves, _ = jax.tree_util.tree_flatten(tree)
@@ -245,6 +246,10 @@ class CheckpointManager:
                 f"{self.retries + 1} attempts: {last_err}") from last_err
 
         self._prune()
+        # bus-only stall record: the goodput ledger (apex_tpu.monitor)
+        # charges synchronous save time against run goodput
+        publish_event("checkpoint_save_stall", step=int(step),
+                      seconds=round(time.perf_counter() - t_start, 6))
         return final
 
     def _prune(self) -> None:
@@ -328,9 +333,14 @@ class CheckpointManager:
         and the walk continues to the next older step. Returns ``(step,
         tree)`` or ``None`` when no valid checkpoint exists.
         """
+        t_start = time.perf_counter()
         for step in reversed(self.all_steps()):
             try:
-                return step, self.restore(step, like)
+                out = step, self.restore(step, like)
+                publish_event(
+                    "checkpoint_restore_stall", step=int(step),
+                    seconds=round(time.perf_counter() - t_start, 6))
+                return out
             except CheckpointCorruptError as e:
                 structured_warning("checkpoint_skipped_corrupt",
                                    step=step, reason=str(e))

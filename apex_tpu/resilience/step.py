@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.amp.grad_scaler import DynamicGradScaler, ScalerState
-from apex_tpu.utils.logging import structured_warning
+from apex_tpu.utils.logging import publish_event, structured_warning
 
 DEFAULT_SCALE_FLOOR = 2.0 ** -14  # smallest normal bf16/fp16-safe scale
 
@@ -54,13 +54,26 @@ class ResilientStep:
         step = resilient_step(train_step, scaler)
         params, sstate, found_inf, loss = step(params, sstate, batch)
         if step.degraded: ...  # storm happened; growth is frozen
+
+    With ``telemetry`` (an :class:`apex_tpu.monitor.Telemetry`), every call
+    also collects a :class:`~apex_tpu.monitor.metrics.TrainMetrics` INSIDE
+    the jitted post-step — param norm of the kept params, norm of the
+    attempted update, overflow flag, post-update loss scale — and logs it
+    (``aux[0]``, when present, is logged as ``loss``). Metric values stay
+    on device; the only host traffic is the ``found_inf`` fetch the loop
+    needs anyway. The latest collected pytree is also kept on
+    ``self.last_metrics``.
     """
 
     def __init__(self, step_fn: Callable, scaler: DynamicGradScaler, *,
                  max_consecutive_overflows: int = 8,
-                 scale_floor: float = DEFAULT_SCALE_FLOOR):
+                 scale_floor: float = DEFAULT_SCALE_FLOOR,
+                 telemetry=None):
         self.step_fn = step_fn
         self.scaler = scaler
+        self.telemetry = telemetry
+        self.last_metrics = None
+        self._step_index = 0
         self.max_consecutive_overflows = max_consecutive_overflows
         # the floor is applied in this wrapper's own (jitted) post-step, not
         # by mutating the caller's scaler — a scaler shared with another
@@ -71,22 +84,52 @@ class ResilientStep:
         self.skipped_steps = 0
         self.degraded = False
 
-        def _post(new_params, params, sstate, found_inf, *, freeze_growth):
-            params = skip_on_overflow(new_params, params, found_inf)
+        def _post(new_params, params, sstate, found_inf, *, freeze_growth,
+                  with_metrics):
+            kept = skip_on_overflow(new_params, params, found_inf)
             sstate = self.scaler.update(sstate, found_inf,
                                         freeze_growth=freeze_growth)
-            return params, sstate._replace(
+            sstate = sstate._replace(
                 scale=jnp.maximum(sstate.scale, jnp.float32(scale_floor)))
+            tm = None
+            if with_metrics:
+                from apex_tpu.monitor.metrics import collect_metrics
 
-        # one trace per freeze_growth value; everything but the scalar
-        # found_inf fetch below stays on device
-        self._post = jax.jit(_post, static_argnames=("freeze_growth",))
+                # update_norm is the ATTEMPTED update (pre-skip): on a
+                # storm step it shows the non-finite/huge step that was
+                # discarded, which is the diagnostic signal
+                tm = collect_metrics(
+                    params=kept,
+                    updates=jax.tree_util.tree_map(
+                        lambda n, o: n.astype(jnp.float32)
+                        - o.astype(jnp.float32), new_params, params),
+                    scaler_state=sstate, found_inf=found_inf)
+            return kept, sstate, tm
+
+        # one trace per (freeze_growth, with_metrics) value; everything but
+        # the scalar found_inf fetch below stays on device
+        self._post = jax.jit(
+            _post, static_argnames=("freeze_growth", "with_metrics"))
 
     def __call__(self, params: Any, sstate: ScalerState, *batch):
         new_params, found_inf, *aux = self.step_fn(params, sstate, *batch)
-        params, sstate = self._post(new_params, params, sstate, found_inf,
-                                    freeze_growth=self.degraded)
-        if bool(found_inf):
+        with_metrics = self.telemetry is not None
+        params, sstate, tm = self._post(new_params, params, sstate,
+                                        found_inf,
+                                        freeze_growth=self.degraded,
+                                        with_metrics=with_metrics)
+        skipped = bool(found_inf)
+        if with_metrics:
+            self.last_metrics = tm
+            self.telemetry.log_step(
+                self._step_index, metrics=tm,
+                loss=aux[0] if aux else None, skipped=skipped)
+        self._step_index += 1
+        if skipped:
+            # bus-only (emit=False): per-step records must not spam stderr,
+            # but the goodput ledger counts every discarded update
+            publish_event("overflow_step_skipped",
+                          consecutive=self.consecutive_overflows + 1)
             self.skipped_steps += 1
             self.consecutive_overflows += 1
             if (not self.degraded and self.consecutive_overflows

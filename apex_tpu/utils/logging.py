@@ -19,9 +19,57 @@ import json
 import sys
 import time
 import warnings
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 _seen_warnings: set = set()
+
+# process-local event bus: every publish_event/structured_warning record is
+# handed to these callbacks, so in-process consumers (the goodput ledger,
+# a Telemetry sink mirroring events into its JSONL) see the same stream a
+# log pipeline would scrape from stderr — without parsing stderr.
+_event_subscribers: List[Callable[[Dict[str, Any]], None]] = []
+
+
+def subscribe_events(callback: Callable[[Dict[str, Any]], None]
+                     ) -> Callable[[], None]:
+    """Register ``callback(record)`` for every published event record.
+
+    Returns an unsubscribe callable (idempotent). Subscribers must be
+    cheap and non-throwing; a raising subscriber is reported once and the
+    event still reaches the remaining subscribers.
+    """
+    _event_subscribers.append(callback)
+
+    def _unsubscribe() -> None:
+        try:
+            _event_subscribers.remove(callback)
+        except ValueError:
+            pass
+
+    return _unsubscribe
+
+
+def publish_event(event: str, *, level: str = "info", stream=None,
+                  emit: bool = False, **fields) -> Dict[str, Any]:
+    """Build an event record, notify subscribers, optionally print it.
+
+    ``emit=True`` prints one JSON line (``structured_warning``'s behavior);
+    ``emit=False`` is for high-rate or purely internal events (per-step
+    overflow skips, checkpoint stall timings) that monitoring consumers
+    subscribe to but that must not spam stderr.
+    """
+    rec: Dict[str, Any] = {"level": level, "event": event}
+    rec.update(fields)
+    if emit:
+        print(json.dumps(rec, sort_keys=True, default=float),
+              file=stream or sys.stderr, flush=True)
+    for cb in list(_event_subscribers):
+        try:
+            cb(rec)
+        except Exception as e:  # a broken consumer must not kill training
+            one_time_warning(f"event subscriber {cb!r} raised "
+                             f"{type(e).__name__}: {e}")
+    return rec
 
 
 def deprecated_warning(msg: str) -> None:
@@ -52,11 +100,8 @@ def structured_warning(event: str, stream=None, **fields) -> Dict[str, Any]:
     instead of scraping free-text warnings. Returns the record (tests
     assert on it). Device scalars in ``fields`` are coerced to floats.
     """
-    rec: Dict[str, Any] = {"level": "warning", "event": event}
-    rec.update(fields)
-    print(json.dumps(rec, sort_keys=True, default=float),
-          file=stream or sys.stderr, flush=True)
-    return rec
+    return publish_event(event, level="warning", stream=stream, emit=True,
+                         **fields)
 
 
 class AverageMeter:
@@ -110,10 +155,17 @@ class MetricLogger:
     def flush(self) -> None:
         if not self._buffer:
             return
+        import jax  # deferred: logging must stay importable without a backend
+
+        # ONE host sync for the whole buffer: batch-transfer every buffered
+        # device array in a single device_get (per-value float() would pay
+        # one blocking round-trip per metric per step)
+        host = jax.device_get([list(metrics.values())
+                               for _, _, metrics in self._buffer])
         rows = []
-        for step, t, metrics in self._buffer:
+        for (step, t, metrics), vals in zip(self._buffer, host):
             row = {"step": step, "t": round(t, 3)}
-            for k, val in metrics.items():
+            for k, val in zip(metrics.keys(), vals):
                 v = float(val)
                 row[k] = v
                 self.meters.setdefault(k, AverageMeter(k, ":.4f")).update(v)

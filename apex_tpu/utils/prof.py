@@ -57,6 +57,10 @@ class StepTimer:
         self._t0 = time.perf_counter()
 
     def stop(self, block_on=None):
+        if self._t0 is None:
+            raise RuntimeError(
+                "StepTimer.stop() called before start() (or after reset()) "
+                "— call start() at the top of the step")
         if block_on is not None:
             jax.block_until_ready(block_on)
         self.last = time.perf_counter() - self._t0
@@ -76,6 +80,42 @@ CHIP_PEAKS = {
     "v5p": {"hbm_gbps": 2765.0, "tflops": 459.0},
 }
 
+# device_kind substrings → CHIP_PEAKS generation, most specific first
+# (``"v5"`` alone is the v5p kind string "TPU v5"; the lite parts say so)
+_KIND_TO_GEN = (
+    ("v5e", "v5e"), ("v5 lite", "v5e"), ("v5litepod", "v5e"),
+    ("v6e", "v6e"), ("v6 lite", "v6e"), ("trillium", "v6e"),
+    ("v5p", "v5p"), ("v5", "v5p"),
+)
+
+
+def detect_chip(devices=None) -> Optional[str]:
+    """Map the attached TPU's ``device_kind`` to a :data:`CHIP_PEAKS` key.
+
+    Returns ``None`` off-TPU, when no backend is reachable, or for an
+    unrecognized TPU kind (reported once via ``one_time_warning`` so new
+    generations fail loudly instead of silently using v5e peaks).
+    ``devices`` is injectable for tests; defaults to ``jax.devices()``.
+    """
+    from apex_tpu.utils.logging import one_time_warning
+
+    if devices is None:
+        try:
+            devices = jax.devices()
+        except Exception:  # backend init can fail (no relay, bad env)
+            return None
+    if not devices or getattr(devices[0], "platform", None) != "tpu":
+        return None
+    kind = str(getattr(devices[0], "device_kind", "")).lower()
+    for pat, gen in _KIND_TO_GEN:
+        if pat in kind:
+            return gen
+    one_time_warning(
+        f"unknown TPU device_kind {kind!r}: roofline peaks fall back to "
+        f"PALLAS_AXON_TPU_GEN — add the new generation to "
+        f"apex_tpu.utils.prof.CHIP_PEAKS/_KIND_TO_GEN")
+    return None
+
 
 def roofline(fn, *args, chip: str | None = None,
              measured_ms: float | None = None) -> dict:
@@ -86,7 +126,9 @@ def roofline(fn, *args, chip: str | None = None,
     Returns ``{flops, bytes, t_mxu_ms, t_hbm_ms, bound, ideal_ms}`` plus,
     when ``measured_ms`` is given, ``achieved_frac`` (ideal/measured —
     how close the step runs to its own roofline) and the per-resource
-    fractions. ``chip`` defaults to ``PALLAS_AXON_TPU_GEN`` (v5e).
+    fractions. ``chip`` defaults to the generation auto-detected from
+    ``jax.devices()[0].device_kind`` (:func:`detect_chip`), then the
+    ``PALLAS_AXON_TPU_GEN`` env var, then v5e.
 
     Caveat on ``bytes``: XLA's "bytes accessed" counts every operand's
     bytes per op, including VMEM-resident reuse that never touches HBM,
@@ -99,7 +141,8 @@ def roofline(fn, *args, chip: str | None = None,
     """
     import os
 
-    chip = chip or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    chip = (chip or detect_chip()
+            or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"))
     peaks = CHIP_PEAKS.get(chip, CHIP_PEAKS["v5e"])
     compiled = jax.jit(fn).lower(*args).compile()
     ca = compiled.cost_analysis()

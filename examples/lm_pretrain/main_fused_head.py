@@ -13,6 +13,13 @@ With ``--ckpt-dir`` the loop becomes preemptible: it resumes from the
 newest valid checkpoint, saves every ``--save-every`` steps through the
 atomic CheckpointManager, and a SIGTERM/SIGINT triggers one final
 synchronous save before exit (docs/robustness.md).
+
+With ``--telemetry-jsonl PATH`` every step emits a telemetry row
+(``{step, loss, grad_norm, loss_scale, step_ms, tokens_per_s, mfu, ...}``)
+through ``apex_tpu.monitor.Telemetry`` — grad/param norms are collected
+inside the jitted grad computation, checkpoint saves are charged to the
+goodput ledger, and the run ends with a goodput summary line
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -38,6 +45,8 @@ def main():
     ap.add_argument("--ckpt-dir", type=str, default=None,
                     help="enable resumable checkpointing into this dir")
     ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--telemetry-jsonl", type=str, default=None,
+                    help="emit per-step telemetry rows to this JSONL file")
     args = ap.parse_args()
 
     from apex_tpu.models.gpt2 import GPT2, GPT2Config
@@ -72,7 +81,22 @@ def main():
 
     @jax.jit
     def grads_of(params):
-        return jax.value_and_grad(loss_fn)(params)
+        from apex_tpu.monitor.metrics import collect_metrics
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # in-graph metrics: the norms trace into this same jit; values
+        # leave as device scalars, nothing syncs until telemetry flushes
+        # (loss_scale=1.0 — this example trains unscaled bf16-first)
+        tm = collect_metrics(grads=grads, params=params, loss=loss,
+                             loss_scale=1.0)
+        return loss, grads, tm
+
+    telemetry = None
+    if args.telemetry_jsonl:
+        from apex_tpu.monitor import Telemetry
+        telemetry = Telemetry(args.telemetry_jsonl,
+                              tokens_per_step=args.batch * args.seq)
+        telemetry.calibrate(grads_of, params)
 
     # optional resilience: resumable atomic checkpoints + preemption guard
     manager = guard = None
@@ -99,14 +123,20 @@ def main():
 
     l0 = loss = None
     try:
+        if telemetry is not None:
+            telemetry.start()
         for step in range(start_step, args.steps):
-            loss, grads = grads_of(params)
+            loss, grads, tm = grads_of(params)
             params = opt.step(grads)
+            if telemetry is not None:
+                # the float(loss) print below is the loop's host sync; the
+                # logged metric values stay device arrays until flush
+                telemetry.log_step(step, metrics=tm)
             if l0 is None:
                 l0 = float(loss)
             print(f"step {step}: loss {float(loss):.4f}", flush=True)
             if manager is not None and step % args.save_every == 0:
-                save(step, params)
+                save(step, params)  # save stalls land in the goodput ledger
             if guard is not None and guard.should_stop():
                 save(step, params)  # final synchronous save, then stop
                 print(f"preempted: saved step {step}, exiting", flush=True)
@@ -114,6 +144,11 @@ def main():
     finally:
         if guard is not None:
             guard.restore()
+        if telemetry is not None:
+            telemetry.close()
+            import json
+            print("telemetry:",
+                  json.dumps(telemetry.summary()["goodput"]), flush=True)
     # l0 is the first loss seen by THIS process — only meaningful to
     # compare once we have run at least two steps since (a resumed run may
     # have had a single step left)

@@ -1,0 +1,436 @@
+"""Telemetry pipeline tests (marker: ``monitor``).
+
+Covers the observability contract end to end: in-graph ``TrainMetrics``
+stay in-graph (no host callbacks traced into the step, the step remains
+ONE jitted call), the JSONL schema round-trips, the goodput ledger's
+arithmetic holds under injected overflow storms, the bench regression gate
+passes/fails correctly, and ``apex-tpu-bench --telemetry-jsonl`` emits
+schema-valid rows on CPU.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp.grad_scaler import DynamicGradScaler
+from apex_tpu.monitor import (GoodputLedger, Telemetry, TrainMetrics,
+                              collect_metrics, read_jsonl, validate_row)
+from apex_tpu.monitor.telemetry import PERF_ROW_KEYS
+from apex_tpu.resilience import FaultInjector, resilient_step
+from apex_tpu.utils.logging import (MetricLogger, publish_event,
+                                    structured_warning, subscribe_events)
+from apex_tpu.utils.prof import StepTimer, detect_chip, roofline
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.monitor
+
+
+def _params():
+    return {"w": jnp.full((4, 4), 2.0), "b": jnp.ones((8,), jnp.bfloat16)}
+
+
+# ------------------------------------------------------------ in-graph
+
+def test_collect_metrics_values_under_jit():
+    params = _params()
+    grads = jax.tree_util.tree_map(lambda x: jnp.ones_like(x) * 0.5, params)
+
+    @jax.jit
+    def step(params, grads):
+        return collect_metrics(grads=grads, params=params,
+                               loss=jnp.float32(2.5), loss_scale=8.0)
+
+    tm = step(params, grads)
+    n = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    np.testing.assert_allclose(float(tm.grad_norm),
+                               math.sqrt(n * 0.25), rtol=1e-5)
+    np.testing.assert_allclose(float(tm.param_norm),
+                               math.sqrt(16 * 4.0 + 8 * 1.0), rtol=1e-2)
+    assert float(tm.loss) == 2.5
+    assert float(tm.loss_scale) == 8.0
+    assert not bool(tm.found_inf)
+    assert tm.update_norm is None  # not collected -> absent, still a pytree
+
+
+def test_collect_metrics_traces_no_host_callbacks():
+    """The acceptance guarantee: metric collection adds no host syncs —
+    the jaxpr of a collecting step contains no callback primitives and the
+    whole step stays ONE jitted call that returns the metrics."""
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+
+    def step(params, grads):
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+        tm = collect_metrics(grads=grads, params=new, loss_scale=1.0)
+        return new, tm
+
+    jaxpr = str(jax.make_jaxpr(step)(params, grads))
+    assert "callback" not in jaxpr  # covers pure_callback/io_callback/debug
+    jitted = jax.jit(step)
+    new, tm = jitted(params, grads)  # one call yields params AND metrics
+    assert isinstance(tm, TrainMetrics)
+    assert isinstance(tm.grad_norm, jax.Array)
+
+
+def test_found_inf_detects_nan():
+    grads = {"w": jnp.array([1.0, jnp.nan])}
+    tm = jax.jit(lambda g: collect_metrics(grads=g))(grads)
+    assert bool(tm.found_inf)
+
+
+def test_scaler_unscale_and_norm_fused():
+    scaler = DynamicGradScaler(init_scale=4.0)
+    state = scaler.init()
+    grads = {"w": jnp.full((8,), 4.0)}
+    out, gnorm, found_inf = scaler.unscale_and_norm(grads, state)
+    np.testing.assert_allclose(np.asarray(out["w"]), np.full((8,), 1.0))
+    np.testing.assert_allclose(float(gnorm), math.sqrt(8.0), rtol=1e-6)
+    assert not bool(found_inf)
+
+
+# ------------------------------------------------------------ telemetry
+
+def test_telemetry_jsonl_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    tel = Telemetry(path, tokens_per_step=256.0, flops_per_step=1e9,
+                    chip="v5e").start()
+    params = _params()
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    tm = jax.jit(lambda p, g: collect_metrics(
+        grads=g, params=p, loss=jnp.float32(1.0), loss_scale=1.0))(
+            params, grads)
+    for i in range(3):
+        tel.log_step(i, metrics=tm)
+    tel.close()
+    rows, events = read_jsonl(path)
+    assert len(rows) == 3 and not events
+    for row in rows:
+        validate_row(row, require=PERF_ROW_KEYS)
+        assert row["tokens_per_s"] > 0
+        assert row["mfu"] >= 0
+        assert row["loss_scale"] == 1.0
+
+
+def test_telemetry_mirrors_structured_events(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    tel = Telemetry(path)
+    structured_warning("overflow_storm", consecutive_overflows=8)
+    with tel.span("save"):
+        pass
+    tel.close()
+    # events published after close must NOT land in the file
+    structured_warning("after_close")
+    _, events = read_jsonl(path)
+    names = [e["event"] for e in events]
+    assert "overflow_storm" in names
+    assert "span" in names
+    assert "after_close" not in names
+    span = next(e for e in events if e["event"] == "span")
+    assert span["name"] == "save" and span["ms"] >= 0
+
+
+def test_telemetry_no_sync_until_flush(tmp_path, monkeypatch):
+    """log_step buffers device arrays; flush() does ONE batched
+    device_get for the whole buffer (the MetricLogger satellite)."""
+    calls = []
+    real = jax.device_get
+    monkeypatch.setattr(jax, "device_get", lambda x: calls.append(1) or real(x))
+    logger = MetricLogger(str(tmp_path / "m.jsonl"))
+    for i in range(5):
+        logger.log(i, loss=jnp.float32(i), norm=jnp.float32(2 * i))
+    assert calls == []  # nothing fetched while buffering
+    logger.flush()
+    assert len(calls) == 1  # one host sync for 10 buffered device scalars
+
+
+def test_goodput_ledger_arithmetic():
+    led = GoodputLedger()
+    led.record_step(1.0)
+    led.record_step(1.0)
+    led.record_step(0.5, productive=False)
+    led.record_stall("checkpoint_save", 0.5)
+    s = led.summary()
+    assert s["steps"] == 3 and s["skipped_steps"] == 1
+    assert s["productive_s"] == pytest.approx(2.0)
+    assert s["lost_s"] == pytest.approx(1.0)
+    assert s["goodput_frac"] == pytest.approx(2.0 / 3.0)
+    assert s["lost_by_cause"] == {"checkpoint_save": pytest.approx(0.5),
+                                  "overflow_skip": pytest.approx(0.5)}
+
+
+def test_goodput_ledger_subscribes_to_stall_events():
+    with GoodputLedger() as led:
+        publish_event("checkpoint_save_stall", step=3, seconds=1.25)
+        publish_event("checkpoint_restore_stall", step=3, seconds=0.25)
+    # detached: later events must not be counted
+    publish_event("checkpoint_save_stall", step=4, seconds=99.0)
+    s = led.summary()
+    assert s["lost_by_cause"]["checkpoint_save"] == pytest.approx(1.25)
+    assert s["lost_by_cause"]["checkpoint_restore"] == pytest.approx(0.25)
+    assert s["events"]["checkpoint_save_stall"] == 1
+
+
+def test_checkpoint_save_publishes_stall_event(tmp_path):
+    # call-time imports for BOTH sides: test_chip_worker's module purge can
+    # leave collection-time and re-imported apex_tpu identities coexisting,
+    # and publisher + subscriber must share one event-bus module
+    from apex_tpu.monitor.goodput import GoodputLedger as Ledger
+    from apex_tpu.resilience import CheckpointManager
+
+    with Ledger() as led:
+        CheckpointManager(str(tmp_path)).save(1, _params())
+    assert led.events.get("checkpoint_save_stall") == 1
+    assert led.lost_by_cause["checkpoint_save"] > 0
+
+
+# ---------------------------------------------- overflow-storm goodput
+
+@pytest.mark.fault
+def test_goodput_under_injected_overflow_storm(tmp_path):
+    """FaultInjector NaN burst through resilient_step with telemetry:
+    every poisoned step is skipped, charged as lost time, and the emitted
+    rows carry the overflow flag and the backed-off scale."""
+    inj = FaultInjector(seed=3).nan_burst(start=2, length=3)
+    scaler = DynamicGradScaler(init_scale=2.0 ** 8, growth_interval=1000)
+    path = str(tmp_path / "storm.jsonl")
+    tel = Telemetry(path, tokens_per_step=1.0).start()
+
+    params = {"w": jnp.ones((4,))}
+
+    def train_step(params, sstate, grads):
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params,
+                                     grads)
+        from apex_tpu.multi_tensor.functional import tree_check_finite
+        return new, tree_check_finite(grads), jnp.float32(1.0)
+
+    step = resilient_step(train_step, scaler, telemetry=tel)
+    sstate = scaler.init()
+    grads = {"w": jnp.full((4,), 0.5)}
+    total = 8
+    for i in range(total):
+        g = inj.poison_grads(grads, i)
+        params, sstate, found_inf, _loss = step(params, sstate, g)
+    tel.close()
+
+    assert step.skipped_steps == 3
+    g = tel.ledger.summary()
+    assert g["steps"] == total
+    assert g["skipped_steps"] == 3
+    assert g["events"]["overflow_step_skipped"] == 3
+    assert g["lost_by_cause"]["overflow_skip"] > 0
+    assert 0.0 < g["goodput_frac"] < 1.0
+    assert g["productive_s"] + g["lost_s"] == pytest.approx(
+        sum(v for v in g["lost_by_cause"].values()) + g["productive_s"])
+
+    rows, _events = read_jsonl(path)
+    assert len(rows) == total
+    skipped_rows = [r for r in rows if r["found_inf"]]
+    assert len(skipped_rows) == 3
+    # params kept + scale backed off on the skipped steps; update_norm and
+    # param_norm were collected in-graph by the resilient post-step
+    for r in rows:
+        assert "param_norm" in r and "update_norm" in r
+        assert "loss_scale" in r and r["loss"] == 1.0
+
+
+# ------------------------------------------------------------ satellites
+
+def test_steptimer_stop_before_start_raises():
+    t = StepTimer()
+    with pytest.raises(RuntimeError, match="before start"):
+        t.stop()
+    t.start()
+    assert t.stop() >= 0.0
+    t.reset()
+    with pytest.raises(RuntimeError):
+        t.stop()
+
+
+class _FakeDev:
+    def __init__(self, platform, kind):
+        self.platform = platform
+        self.device_kind = kind
+
+
+@pytest.mark.parametrize("kind,expected", [
+    ("TPU v5e", "v5e"), ("TPU v5 lite", "v5e"), ("TPU v6e", "v6e"),
+    ("TPU v6 lite", "v6e"), ("TPU v5p", "v5p"), ("TPU v5", "v5p"),
+])
+def test_detect_chip_known_kinds(kind, expected):
+    assert detect_chip([_FakeDev("tpu", kind)]) == expected
+
+
+def test_detect_chip_cpu_and_unknown():
+    assert detect_chip([_FakeDev("cpu", "cpu")]) is None
+    # unknown TPU generation: warns once, returns None (env fallback)
+    assert detect_chip([_FakeDev("tpu", "TPU v9 hyper")]) is None
+
+
+def test_roofline_uses_detected_chip(monkeypatch):
+    # patch + call through the SAME module object (see identity note above)
+    import apex_tpu.utils.prof as prof
+
+    monkeypatch.setattr(prof, "detect_chip", lambda devices=None: "v6e")
+    out = prof.roofline(lambda x: x @ x, jnp.ones((64, 64)))
+    assert out["chip"] == "v6e"
+    assert out["flops"] >= 0
+
+
+# ------------------------------------------------------- regression gate
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+def _gate(current, baseline, *extra):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "check_regression.py"),
+         current, baseline, *extra],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_check_regression_pass_and_fail(tmp_path):
+    rows = [{"step": i, "loss": 4.0, "grad_norm": 1.0, "loss_scale": 1.0,
+             "step_ms": 10.0, "tokens_per_s": 1000.0, "mfu": 0.02}
+            for i in range(5)]
+    base = str(tmp_path / "base.jsonl")
+    _write_jsonl(base, rows)
+
+    same = str(tmp_path / "same.jsonl")
+    _write_jsonl(same, rows)
+    r = _gate(same, base)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    slow = str(tmp_path / "slow.jsonl")
+    _write_jsonl(slow, [{**row, "step_ms": row["step_ms"] * 1.2}
+                        for row in rows])
+    r = _gate(slow, base)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "REGRESSION" in r.stdout and "step_ms" in r.stdout
+
+    # within tolerance at 25%: the same 20% slowdown passes
+    r = _gate(slow, base, "--tolerance", "0.25")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_check_regression_throughput_direction(tmp_path):
+    base = str(tmp_path / "b.jsonl")
+    cur = str(tmp_path / "c.jsonl")
+    _write_jsonl(base, [{"step": 0, "tokens_per_s": 1000.0},
+                        {"step": 1, "tokens_per_s": 1000.0}])
+    _write_jsonl(cur, [{"step": 0, "tokens_per_s": 700.0},
+                       {"step": 1, "tokens_per_s": 700.0}])
+    r = _gate(cur, base, "--warmup", "0")
+    assert r.returncode == 1  # throughput DROP is a regression
+    r = _gate(base, cur, "--warmup", "0")
+    assert r.returncode == 0  # throughput gain is not
+
+
+def test_check_regression_single_row_jsonl(tmp_path):
+    """A one-row capture is a single JSON dict too — it must be read as a
+    telemetry row, not misclassified as an (empty) suite."""
+    base = str(tmp_path / "b.jsonl")
+    cur = str(tmp_path / "c.jsonl")
+    _write_jsonl(base, [{"step": 0, "step_ms": 10.0}])
+    _write_jsonl(cur, [{"step": 0, "step_ms": 13.0}])
+    assert _gate(base, base).returncode == 0
+    assert _gate(cur, base).returncode == 1
+
+
+def test_telemetry_flush_every_bounds_buffer(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    tel = Telemetry(path, flush_every=2).start()
+    for i in range(5):
+        tel.log_step(i, loss=jnp.float32(i))
+    # 4 rows flushed by the every-2 policy; row 5 still buffered
+    rows, _ = read_jsonl(path)
+    assert len(rows) == 4
+    tel.close()
+    rows, _ = read_jsonl(path)
+    assert len(rows) == 5
+
+
+def test_check_regression_suite_baseline(tmp_path):
+    suite = {"backend": "cpu", "complete": True,
+             "bench_a": {"metric": "a_ms", "value": 10.0, "unit": "ms",
+                         "step_ms": 10.0}}
+    basep = str(tmp_path / "BENCH_BASE.json")
+    with open(basep, "w") as f:
+        json.dump(suite, f)
+    worse = {"backend": "cpu", "complete": True,
+             "bench_a": {"metric": "a_ms", "value": 13.0, "unit": "ms",
+                         "step_ms": 13.0}}
+    curp = str(tmp_path / "cur.json")
+    with open(curp, "w") as f:
+        json.dump(worse, f)
+    assert _gate(basep, basep).returncode == 0
+    assert _gate(curp, basep).returncode == 1
+    assert _gate(str(tmp_path / "nope.json"), basep).returncode == 2
+
+
+# ----------------------------------------------------------- bench smoke
+
+def _run_cli(args, timeout=600):
+    env = dict(os.environ)
+    kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(kept + [ROOT])
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run([sys.executable, "-m", "apex_tpu.bench_cli"]
+                          + args, cwd=ROOT, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+
+
+def test_bench_cli_telemetry_smoke(tmp_path):
+    """Tier-1 gate: ``apex-tpu-bench --telemetry-jsonl`` runs a few steps
+    on CPU and every emitted row validates against the schema with the
+    acceptance keys present."""
+    path = str(tmp_path / "bench.jsonl")
+    # pre-seed the file with a stale row: a per-run sink must truncate, or
+    # mixed-run medians would skew the regression gate; the '=' flag form
+    # must be recognized too
+    with open(path, "w") as f:
+        f.write(json.dumps({"step": 99, "stale": True}) + "\n")
+    r = _run_cli([f"--telemetry-jsonl={path}", "--steps", "4"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    headline = json.loads(r.stdout.strip().splitlines()[-1])
+    assert headline["metric"] == "telemetry_train_step_ms_lm_tiny"
+    assert headline["value"] > 0
+    assert headline["goodput"] == pytest.approx(1.0)
+
+    rows, _events = read_jsonl(path)
+    assert len(rows) == 4  # the stale pre-run row was truncated away
+    for row in rows:
+        validate_row(row, require=PERF_ROW_KEYS)
+        assert row["step_ms"] > 0
+        assert row["tokens_per_s"] > 0
+        assert row["loss_scale"] == 2.0 ** 12
+
+
+def test_bench_cli_step_is_single_jitted_call():
+    """The telemetry bench's step function is ONE jitted callable whose
+    single invocation yields the new state AND the metrics — and its
+    trace contains no host callbacks."""
+    from apex_tpu.bench_cli import _make_telemetry_step
+    # resolved at call time alongside bench_cli so both share one module
+    # identity even after test_chip_worker's purge (see note above)
+    from apex_tpu.monitor.metrics import TrainMetrics as TM
+
+    step, state, tokens, tokens_per_step = _make_telemetry_step()
+    assert hasattr(step, "lower")  # a jit-wrapped callable, not a python loop
+    jaxpr = str(jax.make_jaxpr(step)(0, state, tokens))
+    assert "callback" not in jaxpr
+    (params, m, v, sstate), tm = step(0, state, tokens)
+    assert isinstance(tm, TM)
+    assert tm.grad_norm is not None and tm.loss_scale is not None
+    assert tokens_per_step == tokens.shape[0] * (tokens.shape[1] - 1)

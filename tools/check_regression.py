@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare a fresh capture against a committed
+baseline and exit nonzero on regression — perf claims become CI-checkable.
+
+Usage::
+
+    python tools/check_regression.py CURRENT BASELINE \
+        [--tolerance 0.10] [--warmup 1] [--metric NAME ...]
+
+``CURRENT`` and ``BASELINE`` each accept either format:
+
+- a **telemetry JSONL** (``apex-tpu-bench --telemetry-jsonl``, or an
+  example run with ``--telemetry-jsonl``): per-step metric rows are
+  aggregated to their **median** over the steady state (the first
+  ``--warmup`` rows dropped; medians shrug off one straggler step), event
+  rows are ignored;
+- a **bench suite JSON** (``BENCH_SUITE.json`` / ``BENCH_*.json`` shape):
+  each sub-bench contributes its headline ``value`` (named by the entry
+  key) plus numeric detail fields as ``<entry>.<field>``.
+
+Only metrics present on BOTH sides are compared (each skip is reported).
+Direction is inferred from the name/unit: ``*_ms``/``*_s``/unit ``ms`` are
+lower-is-better; throughputs and fractions (``tokens_per_s``, ``mfu``,
+``hbm_frac``, ``vs_baseline``, ...) are higher-is-better. A metric
+regresses when it is worse than baseline by more than ``--tolerance``
+(relative). Harness-noise fields (``bench_wall_s``, ``t``, wall stamps)
+are excluded.
+
+Exit status: 0 all compared metrics within tolerance, 1 any regression,
+2 usage error / nothing comparable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# never compared: harness/bookkeeping and training-health values, not perf
+EXCLUDED = {"step", "t", "bench_wall_s", "fetch_floor_ms", "found_inf",
+            "loss_scale", "grad_norm", "param_norm", "update_norm"}
+_LOWER_SUFFIXES = ("_ms", "_s", "_latency")
+# throughput/utilization names trump the time suffixes ("tokens_per_s"
+# ends in "_s" but is a rate)
+_HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
+                 "vs_baseline", "goodput", "imgs", "tokens", "seqs")
+
+
+def lower_is_better(name: str, unit: Optional[str] = None) -> bool:
+    lname = name.lower()
+    if any(h in lname for h in _HIGHER_HINTS):
+        return False
+    if unit == "ms":
+        return True
+    return lname.endswith(_LOWER_SUFFIXES) or lname.endswith("loss")
+
+
+def median(vals: List[float]) -> float:
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    return vals[mid] if n % 2 else 0.5 * (vals[mid - 1] + vals[mid])
+
+
+def metrics_from_jsonl(lines: List[dict], warmup: int) -> Dict[str, Tuple[float, Optional[str]]]:
+    rows = [r for r in lines if "event" not in r]
+    rows = rows[warmup:] if len(rows) > warmup else rows
+    out: Dict[str, Tuple[float, Optional[str]]] = {}
+    if not rows:
+        return out
+    keys = set().union(*(r.keys() for r in rows)) - EXCLUDED
+    for k in sorted(keys):
+        vals = [float(r[k]) for r in rows
+                if isinstance(r.get(k), (int, float))
+                and not isinstance(r.get(k), bool)]
+        if vals:
+            out[k] = (median(vals), None)
+    return out
+
+
+def metrics_from_suite(suite: dict) -> Dict[str, Tuple[float, Optional[str]]]:
+    out: Dict[str, Tuple[float, Optional[str]]] = {}
+    for name, entry in suite.items():
+        if not isinstance(entry, dict) or "error" in entry \
+                or "value" not in entry:
+            continue
+        unit = entry.get("unit")
+        out[name] = (float(entry["value"]), unit)
+        for k, v in entry.items():
+            if k in ("value", "metric", "unit") or k in EXCLUDED:
+                continue
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"{name}.{k}"] = (float(v), None)
+    return out
+
+
+def load_metrics(path: str, warmup: int) -> Dict[str, Tuple[float, Optional[str]]]:
+    """Sniff the file format (JSONL vs one JSON document) and extract
+    ``{metric_name: (value, unit|None)}``."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            # a one-row telemetry JSONL is also a single JSON dict —
+            # disambiguate by shape (suite entries are dicts with "value")
+            is_suite = any(isinstance(v, dict) and "value" in v
+                           for v in doc.values())
+            if not is_suite and "step" in doc:
+                return metrics_from_jsonl([doc], warmup=0)
+            return metrics_from_suite(doc)
+    except ValueError:
+        pass
+    lines = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            lines.append(json.loads(line))
+    return metrics_from_jsonl(lines, warmup)
+
+
+def compare(current: Dict[str, Tuple[float, Optional[str]]],
+            baseline: Dict[str, Tuple[float, Optional[str]]],
+            tolerance: float, only: Optional[List[str]] = None) -> Tuple[List[dict], List[str]]:
+    """Returns ``(results, skipped)``; each result row carries the verdict."""
+    results: List[dict] = []
+    skipped: List[str] = []
+    names = sorted(set(current) | set(baseline))
+    if only:
+        names = [n for n in names if n in only]
+    for name in names:
+        if name not in current or name not in baseline:
+            skipped.append(name)
+            continue
+        cur, unit = current[name]
+        base, base_unit = baseline[name]
+        lower = lower_is_better(name, unit or base_unit)
+        if base == 0:
+            skipped.append(name)
+            continue
+        ratio = cur / base
+        worse = ratio - 1.0 if lower else 1.0 - ratio
+        results.append({
+            "metric": name, "baseline": base, "current": cur,
+            "ratio": round(ratio, 4),
+            "direction": "lower" if lower else "higher",
+            "regressed": worse > tolerance,
+        })
+    return results, skipped
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare a fresh bench capture against a baseline")
+    ap.add_argument("current", help="fresh telemetry JSONL or suite JSON")
+    ap.add_argument("baseline", help="committed BENCH_*.json or JSONL")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed relative slowdown (default 0.10 = 10%%)")
+    ap.add_argument("--warmup", type=int, default=1,
+                    help="leading JSONL rows to drop (compile step)")
+    ap.add_argument("--metric", action="append", default=None,
+                    help="restrict the comparison to these metric names")
+    args = ap.parse_args(argv)
+
+    for path in (args.current, args.baseline):
+        if not os.path.exists(path):
+            print(f"check_regression: no such file: {path}",
+                  file=sys.stderr)
+            return 2
+    try:
+        current = load_metrics(args.current, args.warmup)
+        baseline = load_metrics(args.baseline, args.warmup)
+    except ValueError as e:
+        print(f"check_regression: unparseable input: {e}", file=sys.stderr)
+        return 2
+
+    results, skipped = compare(current, baseline, args.tolerance,
+                               args.metric)
+    for name in skipped:
+        print(f"SKIP       {name} (missing on one side or zero baseline)")
+    for r in results:
+        tag = "REGRESSION" if r["regressed"] else "OK"
+        print(f"{tag:10s} {r['metric']}: baseline={r['baseline']:g} "
+              f"current={r['current']:g} ratio={r['ratio']:g} "
+              f"({r['direction']}-is-better)")
+    regressions = [r for r in results if r["regressed"]]
+    print(json.dumps({"compared": len(results),
+                      "regressions": len(regressions),
+                      "skipped": len(skipped),
+                      "tolerance": args.tolerance}))
+    if not results:
+        print("check_regression: nothing comparable between the two "
+              "captures", file=sys.stderr)
+        return 2
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
