@@ -10,6 +10,15 @@ through :class:`apex_tpu.monitor.Telemetry` so every step lands in PATH as
 ``{step, loss, grad_norm, loss_scale, step_ms, tokens_per_s, mfu, ...}``.
 Feed the JSONL to ``tools/check_regression.py`` against a committed
 baseline to gate perf claims in CI (docs/observability.md).
+
+``apex-tpu-bench --kernels fused_adam_1b,layer_norm [--emit-baseline
+[PATH]]`` runs just that subset of the bench suite against the
+already-selected backend (no relay probing / cache polling — this is the
+per-kernel path of the perf gate, docs/performance.md). With
+``--emit-baseline`` the capture is written as a suite-format JSON
+(default ``BENCH_BASELINE.json``) ready to commit and enforce with
+``tools/check_regression.py CURRENT --suite BENCH_BASELINE.json`` —
+refreshing the committed gate is one command.
 """
 
 from __future__ import annotations
@@ -148,6 +157,58 @@ def _telemetry_bench(jsonl_path: str, steps: int = 8) -> None:
         "goodput": summary["goodput"]["goodput_frac"]}))
 
 
+def _subset_bench(kernels: str | None, emit_baseline: str | None) -> None:
+    """Run a bench-suite subset directly (no worker/cache indirection) and
+    optionally write it as a committed-baseline artifact."""
+    import importlib.util
+    import json
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bench_path = os.path.join(here, "bench.py")
+    if not os.path.exists(bench_path):
+        print("apex-tpu-bench: --kernels/--emit-baseline need the repo "
+              "checkout's bench.py (wheel installs carry only the inline "
+              "headline bench)", file=sys.stderr)
+        raise SystemExit(2)
+    spec = importlib.util.spec_from_file_location("apex_tpu_bench_suite",
+                                                  bench_path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.utils.logging import subscribe_events
+
+    backend = jax.default_backend()
+    only = None
+    if kernels:
+        only = [k.strip() for k in kernels.split(",") if k.strip()]
+    # record which autotuned configs the benched kernels selected (cache
+    # hits publish kernel_autotune on the bus) — the baseline artifact then
+    # carries its own tuning provenance
+    autotune: list = []
+    unsub = subscribe_events(
+        lambda rec: autotune.append(
+            {k: rec[k] for k in ("kernel", "key", "params", "source")
+             if k in rec})
+        if rec.get("event") == "kernel_autotune" else None)
+    try:
+        suite = bench.run_suite(jax, jnp, backend, out_path=None, only=only)
+    finally:
+        unsub()
+    if autotune:
+        suite["autotune"] = autotune
+    if emit_baseline:
+        bench.atomic_write_json(emit_baseline, suite)
+        print(json.dumps({"baseline": emit_baseline, "backend": backend,
+                          "kernels": suite.get("subset") or
+                          [n for n, _ in bench.BENCHES]}))
+    else:
+        print(json.dumps({k: v for k, v in suite.items()
+                          if isinstance(v, dict)}, indent=1))
+
+
 def main() -> None:
     # a preempted bench run (SIGTERM from the scheduler) exits cleanly with
     # a structured record instead of a stack trace mid-measurement; there is
@@ -156,9 +217,20 @@ def main() -> None:
     from apex_tpu.utils.logging import structured_warning
 
     with PreemptionGuard(raise_on_signal=True) as guard:
-        if any(a == "--telemetry-jsonl"
-               or a.startswith("--telemetry-jsonl=")
-               for a in sys.argv[1:]):
+        has_telemetry = any(a == "--telemetry-jsonl"
+                            or a.startswith("--telemetry-jsonl=")
+                            for a in sys.argv[1:])
+        has_subset = any(a.split("=", 1)[0] in ("--kernels",
+                                                "--emit-baseline")
+                         for a in sys.argv[1:])
+        if has_telemetry and has_subset:
+            # parse_known_args would silently swallow the other mode's
+            # flags — refuse instead of pretending both ran
+            print("apex-tpu-bench: --telemetry-jsonl and "
+                  "--kernels/--emit-baseline are separate modes; run "
+                  "them as two invocations", file=sys.stderr)
+            sys.exit(2)
+        if has_telemetry:
             import argparse
 
             ap = argparse.ArgumentParser(prog="apex-tpu-bench")
@@ -166,6 +238,20 @@ def main() -> None:
             ap.add_argument("--steps", type=int, default=8)
             args, _ = ap.parse_known_args(sys.argv[1:])
             _telemetry_bench(args.telemetry_jsonl, args.steps)
+        elif has_subset:
+            import argparse
+
+            ap = argparse.ArgumentParser(prog="apex-tpu-bench")
+            ap.add_argument("--kernels", default=None,
+                            help="comma-separated bench subset "
+                                 "(e.g. fused_adam_1b,layer_norm)")
+            ap.add_argument("--emit-baseline", nargs="?",
+                            const="BENCH_BASELINE.json", default=None,
+                            help="write the capture as a committed-"
+                                 "baseline suite JSON (default "
+                                 "BENCH_BASELINE.json)")
+            args, _ = ap.parse_known_args(sys.argv[1:])
+            _subset_bench(args.kernels, args.emit_baseline)
         else:
             here = os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))

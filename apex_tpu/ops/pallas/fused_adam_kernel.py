@@ -123,6 +123,37 @@ def _pick_block_rows(rows: int) -> int:
     return min(DEFAULT_BLOCK_ROWS, rows)
 
 
+def _flat_block_rows(kernel: str, rows: int, dtype, interpret: bool,
+                     block_rows) -> int:
+    """Streaming-block resolution shared by every flat optimizer kernel:
+    explicit arg > (compiled only) tuned cache entry > heuristic. In
+    interpret mode the grid executes cell-by-cell in Python, so CPU tests
+    always pay ONE kernel invocation — and, per the tune contract, the
+    cache is never consulted there.
+
+    Entries are keyed dtype-agnostic (``dtype=None``): the streaming
+    block depends on the row count, not the element type, and the master-
+    weight variant (fp32 params) must share the entries warmed on the
+    bf16 bench shapes rather than silently missing them. ``dtype`` stays
+    a parameter for call-site symmetry with the other kernels."""
+    del dtype
+    if block_rows:
+        return block_rows
+    if interpret:
+        return rows
+    from apex_tpu.tune.api import pow2_bucket, tuned_params
+
+    def ok(p):
+        br = p["block_rows"]
+        return isinstance(br, int) and br > 0 and br % SUBLANE == 0
+
+    br = tuned_params(
+        kernel, (("rows", pow2_bucket(rows)),),
+        {"block_rows": _pick_block_rows(rows)},
+        dtype=None, interpret=interpret, validate=ok)["block_rows"]
+    return min(br, rows)
+
+
 def _pack_scalars(lr, beta1, beta2, eps, weight_decay, step,
                   bias_correction, inv_scale, found_inf):
     one = jnp.float32(1.0)
@@ -164,9 +195,8 @@ def fused_adam_flat(p: jax.Array, g: jax.Array, m: jax.Array, v: jax.Array,
                          jnp.asarray(found_inf, jnp.float32))
     p2, g2, m2, v2 = _as_rows(p), _as_rows(g), _as_rows(m), _as_rows(v)
     rows = p2.shape[0]
-    # interpret mode executes the grid cell-by-cell in Python — use a
-    # single block so CPU tests pay one kernel invocation, not hundreds
-    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    br = _flat_block_rows("fused_adam", rows, p2.dtype, interpret,
+                          block_rows)
     grid = (pl.cdiv(rows, br),)
 
     def dspec():
@@ -217,9 +247,10 @@ def fused_adam_flat_master(p_master: jax.Array, g: jax.Array, m: jax.Array,
                          jnp.asarray(found_inf, jnp.float32))
     p2, g2, m2, v2 = _as_rows(p_master), _as_rows(g), _as_rows(m), _as_rows(v)
     rows = p2.shape[0]
-    # interpret mode executes the grid cell-by-cell in Python — use a
-    # single block so CPU tests pay one kernel invocation, not hundreds
-    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    # same streaming pattern (one extra lp write) — shares fused_adam's
+    # tuned entries rather than fragmenting the cache
+    br = _flat_block_rows("fused_adam", rows, p2.dtype, interpret,
+                          block_rows)
     grid = (pl.cdiv(rows, br),)
 
     def dspec():

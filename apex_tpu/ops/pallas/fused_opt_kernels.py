@@ -34,7 +34,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops.pallas.fused_adam_kernel import (LANE, _as_rows,
-                                                   _pick_block_rows)
+                                                   _flat_block_rows)
 from apex_tpu.utils.env import interpret_default
 from apex_tpu.utils.flatten import FlatSpec
 
@@ -162,9 +162,8 @@ def fused_lamb_flat(p, g, m, v, row_ids, *, num_tensors: int, lr,
 
     p2, g2, m2, v2 = _as_rows(p), _as_rows(g), _as_rows(m), _as_rows(v)
     rows = p2.shape[0]
-    # interpret mode executes the grid cell-by-cell in Python — use a
-    # single block so CPU tests pay one kernel invocation, not hundreds
-    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    br = _flat_block_rows("fused_lamb", rows, p2.dtype, interpret,
+                          block_rows)
     grid = (pl.cdiv(rows, br),)
 
     u2, m_new, v_new = pl.pallas_call(
@@ -286,9 +285,8 @@ def fused_novograd_flat(p, g, m, v_per_tensor, row_ids, *, num_tensors: int,
         jnp.asarray(inv_scale, _f32), noop]).reshape(1, 7)
 
     p2, g2, m2 = _as_rows(p), _as_rows(g), _as_rows(m)
-    # interpret mode executes the grid cell-by-cell in Python — use a
-    # single block so CPU tests pay one kernel invocation, not hundreds
-    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    br = _flat_block_rows("fused_novograd", rows, p2.dtype, interpret,
+                          block_rows)
     grid = (pl.cdiv(rows, br),)
 
     p_new, m_new = pl.pallas_call(
@@ -352,9 +350,8 @@ def fused_adagrad_flat(p, g, h, *, lr, eps: float = 1e-10,
         jnp.asarray(found_inf, _f32)]).reshape(1, 5)
     p2, g2, h2 = _as_rows(p), _as_rows(g), _as_rows(h)
     rows = p2.shape[0]
-    # interpret mode executes the grid cell-by-cell in Python — use a
-    # single block so CPU tests pay one kernel invocation, not hundreds
-    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    br = _flat_block_rows("fused_adagrad", rows, p2.dtype, interpret,
+                          block_rows)
     grid = (pl.cdiv(rows, br),)
 
     p_new, h_new = pl.pallas_call(

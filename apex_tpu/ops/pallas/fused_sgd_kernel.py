@@ -17,7 +17,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops.pallas.fused_adam_kernel import (LANE, SUBLANE, _as_rows,
-                                                   _pick_block_rows)
+                                                   _flat_block_rows)
 from apex_tpu.utils.env import interpret_default
 
 _f32 = jnp.float32
@@ -80,9 +80,8 @@ def fused_sgd_flat(p: jax.Array, g: jax.Array, momentum_buf: jax.Array,
     ]).reshape(1, _NS)
     p2, g2, b2 = _as_rows(p), _as_rows(g), _as_rows(momentum_buf)
     rows = p2.shape[0]
-    # interpret mode executes the grid cell-by-cell in Python — use a
-    # single block so CPU tests pay one kernel invocation, not hundreds
-    br = block_rows or (rows if interpret else _pick_block_rows(rows))
+    br = _flat_block_rows("fused_sgd", rows, p2.dtype, interpret,
+                          block_rows)
     grid = (pl.cdiv(rows, br),)
 
     def dspec():

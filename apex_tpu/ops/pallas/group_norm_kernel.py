@@ -34,6 +34,8 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops.pallas.tiling import groupnorm_hw_block
+from apex_tpu.tune.api import pow2_bucket, tuned_params
 from apex_tpu.utils.env import interpret_default
 
 _f32 = jnp.float32
@@ -59,12 +61,36 @@ def one_pass_ok(n: int, hw: int, c: int) -> bool:
 
 
 def _pick_hw_block(hw: int, c: int) -> int:
-    budget = max((2 * 1024 * 1024) // max(c * 4, 1), 8)
-    blk = 1 << (budget.bit_length() - 1)
-    blk = min(blk, hw)
-    while hw % blk != 0 and blk > 8:
-        blk //= 2
-    return max(blk, 8)
+    # shared heuristic (ops/pallas/tiling.py), also the autotuner's
+    # default candidate
+    return groupnorm_hw_block(hw, c)
+
+
+def _hw_block(hw: int, c: int, dtype, interpret: bool,
+              hw_block: int | None = None) -> int:
+    """HW-tile resolution: explicit arg > tuned cache entry > heuristic.
+    The stats kernel accumulates per-group partials across HW tiles AND the
+    grid floor-divides hw, so a block that does not tile ``hw`` exactly
+    would silently drop the tail rows — explicit values are validated
+    (ValueError), tuned entries rejected back to the heuristic."""
+    def ok(p):
+        blk = p["hw_block"]
+        return (isinstance(blk, int) and blk >= 8 and blk % 8 == 0
+                and hw % blk == 0)
+
+    if hw_block is not None:
+        if not ok({"hw_block": hw_block}):
+            raise ValueError(
+                f"group_norm hw_block={hw_block!r} invalid for hw={hw}: "
+                f"must be a positive multiple of 8 that divides hw (the "
+                f"two-pass grid floor-divides hw, so a non-divisor would "
+                f"silently skip the HW tail)")
+        return hw_block
+
+    return tuned_params(
+        "group_norm", (("hw", pow2_bucket(hw)), ("c", c)),
+        {"hw_block": _pick_hw_block(hw, c)},
+        dtype=dtype, interpret=interpret, validate=ok)["hw_block"]
 
 
 def _make_sel(c: int, g: int):
@@ -218,12 +244,14 @@ def group_norm_nhwc_pallas(x: jax.Array, num_groups: int,
                            bias: Optional[jax.Array] = None,
                            eps: float = 1e-5, act: str = "",
                            interpret: Optional[bool] = None,
-                           algo: str = "auto"):
+                           algo: str = "auto",
+                           hw_block: Optional[int] = None):
     """Forward: returns (y, mean, rstd) with mean/rstd (N, G) fp32.
 
     ``algo``: "auto" (one-pass when the sample slab fits VMEM — the
     reference's selection rule translated, group_norm.py:193-209),
-    "one_pass", or "two_pass"."""
+    "one_pass", or "two_pass". ``hw_block`` overrides the tuned/heuristic
+    two-pass HW tile (the autotuner's probe path)."""
     if interpret is None:
         interpret = interpret_default()
     n, h, w, c = x.shape
@@ -240,7 +268,7 @@ def group_norm_nhwc_pallas(x: jax.Array, num_groups: int,
     g = num_groups
     hw = h * w
     x3 = x.reshape(n, hw, c)
-    hwb = _pick_hw_block(hw, c)
+    hwb = _hw_block(hw, c, x.dtype, interpret, hw_block)
     grid = (n, hw // hwb)
 
     xspec = pl.BlockSpec((1, hwb, c), lambda i, j: (i, j, 0),

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops.pallas.tiling import norm_block_rows
+from apex_tpu.tune.api import pow2_bucket, tuned_params
 from apex_tpu.utils.env import interpret_default
 
 _f32 = jnp.float32
@@ -35,14 +37,30 @@ SUBLANE = 8
 
 
 def _pick_block_rows(rows: int, hidden: int) -> int:
-    # keep ~4 operand blocks under a few MiB of VMEM; rows is a multiple of 8
-    budget = 2 * 1024 * 1024 // max(hidden * 4, 1)
-    br = 256
-    while br > budget and br > SUBLANE:
-        br //= 2
-    while rows % br != 0 and br > SUBLANE:
-        br //= 2
-    return max(br, SUBLANE)
+    # keep ~4 operand blocks under a few MiB of VMEM; rows is a multiple of
+    # 8 — shared heuristic (ops/pallas/tiling.py), also the autotuner's
+    # default candidate
+    return norm_block_rows(rows, hidden)
+
+
+def _block_rows(rows: int, hidden: int, dtype, interpret: bool,
+                block_rows: int | None = None) -> int:
+    """Row-block resolution: explicit arg > tuned cache entry > heuristic.
+    The tuned entry must still tile the CONCRETE row count exactly (the
+    backward accumulates dgamma across grid steps, so a ragged tail block
+    is not acceptable here)."""
+    if block_rows is not None:
+        return block_rows
+
+    def ok(p):
+        br = p["block_rows"]
+        return (isinstance(br, int) and br >= SUBLANE
+                and br % SUBLANE == 0 and rows % br == 0)
+
+    return tuned_params(
+        "layer_norm", (("rows", pow2_bucket(rows)), ("hidden", hidden)),
+        {"block_rows": _pick_block_rows(rows, hidden)},
+        dtype=dtype, interpret=interpret, validate=ok)["block_rows"]
 
 
 def _pad_rows(x: jax.Array):
@@ -82,13 +100,14 @@ def _ln_fwd_kernel(x_ref, g_ref, b_ref, y_ref, mean_ref, invvar_ref, *,
 
 
 def ln_fwd_pallas(x2: jax.Array, gamma, beta, *, eps: float, rms: bool,
-                  interpret: bool | None = None):
+                  interpret: bool | None = None,
+                  block_rows: int | None = None):
     """x2: (rows, hidden). Returns (y, mean, invvar) with fp32 stats."""
     if interpret is None:
         interpret = interpret_default()
     x2, true_rows = _pad_rows(x2)
     rows, hidden = x2.shape
-    br = _pick_block_rows(rows, hidden)
+    br = _block_rows(rows, hidden, x2.dtype, interpret, block_rows)
     grid = (pl.cdiv(rows, br),)
     affine = gamma is not None
     has_beta = beta is not None
@@ -190,7 +209,8 @@ def _ln_bwd_kernel(dy_ref, s_ref, g_ref, b_ref, mean_ref, invvar_ref,
 
 
 def ln_bwd_pallas(dy2, saved2, gamma, beta, mean, invvar, *, rms: bool,
-                  memory_efficient: bool, interpret: bool | None = None):
+                  memory_efficient: bool, interpret: bool | None = None,
+                  block_rows: int | None = None):
     """Returns (dx, dgamma|None, dbeta|None). saved2 = x2 or y2 (mem-efficient)."""
     if interpret is None:
         interpret = interpret_default()
@@ -199,7 +219,7 @@ def ln_bwd_pallas(dy2, saved2, gamma, beta, mean, invvar, *, rms: bool,
     mean, _ = _pad_rows(mean)
     invvar, _ = _pad_rows(invvar)
     rows, hidden = dy2.shape
-    br = _pick_block_rows(rows, hidden)
+    br = _block_rows(rows, hidden, dy2.dtype, interpret, block_rows)
     nblk = pl.cdiv(rows, br)
     affine = gamma is not None
     has_beta = beta is not None

@@ -29,6 +29,8 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops.pallas._compat import CompilerParams as _CompilerParams
 from jax.experimental.pallas import tpu as pltpu
 
+from apex_tpu.ops.pallas.tiling import softmax_block_rows
+from apex_tpu.tune.api import pow2_bucket, tuned_params
 from apex_tpu.utils.env import interpret_default
 from apex_tpu.utils.tiling import round_up as _round_up
 
@@ -43,15 +45,29 @@ MAX_PALLAS_COLS = 16384
 def _pick_rows(skp: int, sq: int, itemsize: int = 4,
                has_mask: bool = False) -> int:
     """Row-block size from a per-grid-step VMEM budget covering EVERY
-    streamed operand — in + out tiles (double-buffered by the pipeline) plus
-    the int32 mask tile and the fp32 compute temporaries — so fp32+mask at
-    MAX_PALLAS_COLS still fits v5e's ~16 MB VMEM. Clamped to ≥ 8 sublanes
-    and to the (8-rounded) row count so short-sq (decode-style) scores are
-    not padded to a full block."""
-    bytes_per_elt = 2 * (2 * itemsize + (4 if has_mask else 0)) + 8
-    br = (10 << 20) // (skp * bytes_per_elt)
-    br = max(8, min(512, _round_up(br, 8) if br >= 8 else 8))
-    return min(br, _round_up(sq, 8))
+    streamed operand (in + out tiles double-buffered, mask tile, fp32
+    temporaries) — shared heuristic (ops/pallas/tiling.py), also the
+    autotuner's default candidate."""
+    return softmax_block_rows(skp, sq, itemsize, has_mask)
+
+
+def _block_rows(skp: int, sq: int, itemsize: int, has_mask: bool, dtype,
+                interpret: bool, block_rows: int | None = None) -> int:
+    """Row-block resolution: explicit arg > tuned cache entry > heuristic.
+    Any 8-aligned block is grid-legal (sq pads up to a block multiple), so
+    validation only checks alignment."""
+    if block_rows is not None:
+        return block_rows
+
+    def ok(p):
+        br = p["block_rows"]
+        return isinstance(br, int) and br >= 8 and br % 8 == 0
+
+    return tuned_params(
+        "softmax",
+        (("sk", skp), ("sq", pow2_bucket(sq)), ("mask", has_mask)),
+        {"block_rows": _pick_rows(skp, sq, itemsize, has_mask)},
+        dtype=dtype, interpret=interpret, validate=ok)["block_rows"]
 
 
 def _softmax_rows_f32(x32):
@@ -121,17 +137,39 @@ def _sm_causal_chunked_kernel(x_ref, o_ref, xbuf, *, scale, sk_orig, br, bc,
         o_ref[0] = _softmax_rows_f32(x32).astype(o_ref.dtype)
 
 
-def _softmax_fwd_causal_chunked(x3, *, scale, interpret):
+def _softmax_fwd_causal_chunked(x3, *, scale, interpret,
+                                block_rows=None, chunk_cols=None):
     B, sq, sk = x3.shape
     skp = _round_up(sk, 128)
-    br = _pick_rows(skp, sq, x3.dtype.itemsize, False)
-    sqp = _round_up(sq, br)
     # largest chunk that still gives >= 2 chunks; with one row block or one
     # chunk nothing can ever be skipped — signal the caller to use the
-    # plain row-complete kernel instead of paying the staging overhead
-    bc = next((c for c in (512, 256, 128) if skp % c == 0 and skp > c),
-              None)
-    if bc is None or sqp // br < 2:
+    # plain row-complete kernel instead of paying the staging overhead.
+    # 0 encodes "no usable chunk" (cache values must be ints, not None).
+    defaults = {
+        "block_rows": _pick_rows(skp, sq, x3.dtype.itemsize, False),
+        "chunk_cols": next((c for c in (512, 256, 128)
+                            if skp % c == 0 and skp > c), 0),
+    }
+
+    def ok(p):
+        br, bc = p["block_rows"], p["chunk_cols"]
+        return (isinstance(br, int) and isinstance(bc, int)
+                and br >= 8 and br % 8 == 0 and bc > 0 and bc % 128 == 0
+                and skp % bc == 0 and skp > bc)
+
+    if block_rows is None and chunk_cols is None:
+        tuned = tuned_params(
+            "softmax_causal_chunked",
+            (("sk", skp), ("sq", pow2_bucket(sq))),
+            defaults, dtype=x3.dtype, interpret=interpret, validate=ok)
+        br, bc = tuned["block_rows"], tuned["chunk_cols"]
+    else:
+        br = block_rows if block_rows is not None else \
+            defaults["block_rows"]
+        bc = chunk_cols if chunk_cols is not None else \
+            defaults["chunk_cols"]
+    sqp = _round_up(sq, br)
+    if not bc or sqp // br < 2:
         return None
     nc = skp // bc
     xp = jnp.pad(x3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
@@ -159,11 +197,13 @@ def _softmax_fwd_causal_chunked(x3, *, scale, interpret):
     return out[:, :sq, :sk]
 
 
-def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
+def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None,
+                       block_rows=None, chunk_cols=None):
     """x3: (B, sq, sk) scores (B = b·h). mask3: None or (Bm, sqm, sk) with
     Bm in {1, B//h·? } — concretely Bm in {1, B // h} (the reference's
     per-batch mask shared across heads) or B; sqm in {1, sq}. 1/True =
-    masked."""
+    masked. ``block_rows``/``chunk_cols`` override the tuned/heuristic
+    tile geometry (the autotuner's probe path)."""
     if interpret is None:
         interpret = interpret_default()
     B, sq, sk = x3.shape
@@ -173,10 +213,13 @@ def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
         # blocks exist (so upper-triangle chunks can actually be skipped);
         # the helper returns None for degenerate shapes
         out = _softmax_fwd_causal_chunked(x3, scale=scale,
-                                          interpret=interpret)
+                                          interpret=interpret,
+                                          block_rows=block_rows,
+                                          chunk_cols=chunk_cols)
         if out is not None:
             return out
-    br = _pick_rows(skp, sq, x3.dtype.itemsize, mask3 is not None)
+    br = _block_rows(skp, sq, x3.dtype.itemsize, mask3 is not None,
+                     x3.dtype, interpret, block_rows)
     sqp = _round_up(sq, br)
     xp = jnp.pad(x3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
     grid = (B, sqp // br)
@@ -219,14 +262,15 @@ def softmax_fwd_pallas(x3, mask3, *, scale, causal, h=1, interpret=None):
     return out[:, :sq, :sk]
 
 
-def softmax_bwd_pallas(y3, dy3, *, scale, interpret=None):
+def softmax_bwd_pallas(y3, dy3, *, scale, interpret=None, block_rows=None):
     """dx for any variant: masked positions have y == 0 ⇒ dx == 0, so no
     mask input is needed (matches the reference backward kernels)."""
     if interpret is None:
         interpret = interpret_default()
     B, sq, sk = y3.shape
     skp = _round_up(sk, 128)
-    br = _pick_rows(skp, sq, y3.dtype.itemsize)
+    br = _block_rows(skp, sq, y3.dtype.itemsize, False, y3.dtype,
+                     interpret, block_rows)
     sqp = _round_up(sq, br)
     # padded cols have y == 0 ⇒ contribute nothing to the row sum
     yp = jnp.pad(y3, ((0, 0), (0, sqp - sq), (0, skp - sk)))

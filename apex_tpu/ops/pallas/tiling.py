@@ -1,0 +1,108 @@
+"""Shared VMEM-budget / row-block heuristics for the Pallas kernel zoo.
+
+Before this module, three kernels carried their own copy of the same
+arithmetic — ``layer_norm_kernel._pick_block_rows``, ``softmax_kernel``'s
+bytes-per-row budget math, and ``group_norm_kernel._pick_hw_block``. The
+copies are now expressed through two primitives:
+
+- :func:`fit_block_rows` — start from a candidate block and halve until it
+  fits a row budget and (optionally) divides the row count. The
+  layer-norm/group-norm family.
+- :func:`clamp_block_rows` — clamp a raw budget-derived row count into
+  ``[quantum, cap]`` on sublane granularity, optionally bounded by the
+  (rounded) real row count. The softmax family.
+
+The concrete per-kernel heuristics (:func:`norm_block_rows`,
+:func:`softmax_block_rows`, :func:`groupnorm_hw_block`) live here too so
+the kernels AND the autotuner's default candidate generator
+(``apex_tpu.tune.registry``) share one source of truth: with an empty tune
+cache every kernel reproduces exactly these choices (asserted in
+tests/test_tune.py).
+"""
+
+from __future__ import annotations
+
+from apex_tpu.utils.tiling import round_up
+
+SUBLANE = 8
+LANE = 128
+
+# the default per-grid-step VMEM payload budget for a streamed fp32 operand
+# block (the historical "keep ~4 operand blocks under a few MiB" rule)
+NORM_VMEM_BUDGET = 2 * 1024 * 1024
+# the softmax kernels budget for EVERY double-buffered operand at once and
+# therefore get a larger envelope (fits v5e's ~16 MB VMEM worst case)
+SOFTMAX_VMEM_BUDGET = 10 << 20
+
+
+def pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
+def pow2_ceil(n: int) -> int:
+    """Smallest power of two >= n (n >= 1) — the shape-bucketing quantum
+    used by the autotune cache keys."""
+    n = max(int(n), 1)
+    return 1 << (n - 1).bit_length()
+
+
+def vmem_row_budget(row_bytes: int, vmem_bytes: int = NORM_VMEM_BUDGET) -> int:
+    """How many rows of ``row_bytes`` fit the per-block VMEM budget."""
+    return vmem_bytes // max(row_bytes, 1)
+
+
+def fit_block_rows(rows: int, budget_rows: int, *, start: int = 256,
+                   min_rows: int = SUBLANE,
+                   require_divisor: bool = True) -> int:
+    """Halve ``start`` until it fits ``budget_rows`` and (optionally)
+    divides ``rows``; never below ``min_rows``."""
+    br = start
+    while br > budget_rows and br > min_rows:
+        br //= 2
+    if require_divisor:
+        while rows % br != 0 and br > min_rows:
+            br //= 2
+    return max(br, min_rows)
+
+
+def clamp_block_rows(budget_rows: int, *, cap: int = 512,
+                     quantum: int = SUBLANE,
+                     rows_hint: int | None = None) -> int:
+    """Clamp a budget-derived row count into ``[quantum, cap]`` on
+    ``quantum`` granularity; ``rows_hint`` additionally bounds the result
+    by the (quantum-rounded) real row count so short inputs are not padded
+    to a full block."""
+    br = max(quantum, min(cap, round_up(budget_rows, quantum)
+                          if budget_rows >= quantum else quantum))
+    if rows_hint is not None:
+        br = min(br, round_up(rows_hint, quantum))
+    return br
+
+
+# ------------------------------------------------- per-kernel heuristics
+
+
+def norm_block_rows(rows: int, hidden: int) -> int:
+    """LayerNorm/RMSNorm row block: ~4 operand blocks under a few MiB of
+    VMEM; ``rows`` is a multiple of 8 (layer_norm_kernel pads first)."""
+    return fit_block_rows(rows, vmem_row_budget(hidden * 4), start=256)
+
+
+def softmax_block_rows(skp: int, sq: int, itemsize: int = 4,
+                       has_mask: bool = False) -> int:
+    """Softmax row block from a per-grid-step VMEM budget covering EVERY
+    streamed operand — in + out tiles (double-buffered by the pipeline)
+    plus the int32 mask tile and the fp32 compute temporaries — so
+    fp32+mask at the 16384-column cap still fits v5e's ~16 MB VMEM."""
+    bytes_per_elt = 2 * (2 * itemsize + (4 if has_mask else 0)) + 8
+    return clamp_block_rows(SOFTMAX_VMEM_BUDGET // (skp * bytes_per_elt),
+                            rows_hint=sq)
+
+
+def groupnorm_hw_block(hw: int, c: int) -> int:
+    """GroupNorm two-pass HW tile: largest power of two fitting the fp32
+    row budget, clamped to and dividing ``hw``."""
+    budget = max(vmem_row_budget(c * 4), SUBLANE)
+    blk = min(pow2_floor(budget), hw)
+    return fit_block_rows(hw, blk, start=blk)

@@ -487,11 +487,22 @@ def atomic_write_json(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
-def run_suite(jax, jnp, backend: str, out_path: str | None = None) -> dict:
+def run_suite(jax, jnp, backend: str, out_path: str | None = None,
+              only=None) -> dict:
     """Run every bench against an ALREADY-initialized backend. The suite
     dict is rewritten to ``out_path`` after each bench so a mid-run crash
     (or relay death) still leaves a partial artifact on disk. Callable from
-    the background chip worker (tools/chip_worker.py) without re-probing."""
+    the background chip worker (tools/chip_worker.py) without re-probing.
+
+    ``only``: optional collection of bench names (``apex-tpu-bench
+    --kernels``) restricting the run to that subset; unknown names raise
+    so a typo cannot silently produce an empty baseline."""
+    if only is not None:
+        known = {name for name, _ in BENCHES}
+        unknown = sorted(set(only) - known)
+        if unknown:
+            raise ValueError(f"unknown bench name(s) {unknown}; "
+                             f"known: {sorted(known)}")
     from apex_tpu.utils.benchtime import measure_fetch_floor
 
     on_tpu = backend == "tpu"
@@ -516,6 +527,8 @@ def run_suite(jax, jnp, backend: str, out_path: str | None = None) -> dict:
 
     flush()
     for name, fn in BENCHES:
+        if only is not None and name not in only:
+            continue
         try:
             t0 = time.perf_counter()
             entry = fn(jax, jnp, on_tpu, chip, floor_s)
@@ -526,7 +539,11 @@ def run_suite(jax, jnp, backend: str, out_path: str | None = None) -> dict:
             suite[name] = {"error": f"{type(e).__name__}: {e}"}
             print(f"[bench] {name} FAILED: {e}", file=sys.stderr, flush=True)
         flush()
-    suite["complete"] = True
+    # a subset capture must never read as a full suite (bench.py's cache
+    # promotion and the regression gate both key off "complete")
+    suite["complete"] = only is None
+    if only is not None:
+        suite["subset"] = sorted(only)
     flush()
     return suite
 

@@ -6,6 +6,16 @@ Usage::
 
     python tools/check_regression.py CURRENT BASELINE \
         [--tolerance 0.10] [--warmup 1] [--metric NAME ...]
+    python tools/check_regression.py CURRENT --suite BENCH_BASELINE.json \
+        [--kernels fused_adam_1b,layer_norm] [--tolerance 0.10]
+
+The second form is the per-kernel perf gate: ``--suite`` names the
+committed suite-format baseline (``apex-tpu-bench --kernels ...
+--emit-baseline``), results are grouped and summarized per kernel entry,
+and ``--kernels`` restricts the gate to a subset of entries (a fresh
+subset capture then gates only what it measured). CPU-interpret numbers
+gate CI; real-chip numbers are checked in from bench runs
+(docs/performance.md "Autotuning and the perf baseline gate").
 
 ``CURRENT`` and ``BASELINE`` each accept either format:
 
@@ -151,11 +161,40 @@ def compare(current: Dict[str, Tuple[float, Optional[str]]],
     return results, skipped
 
 
+def filter_kernels(metrics: Dict[str, Tuple[float, Optional[str]]],
+                   kernels: List[str]) -> Dict[str, Tuple[float, Optional[str]]]:
+    """Keep only metrics belonging to the named suite entries (the entry
+    headline ``name`` plus its ``name.<field>`` details)."""
+    keep = set(kernels)
+    return {name: v for name, v in metrics.items()
+            if name in keep or name.split(".", 1)[0] in keep}
+
+
+def summarize_per_kernel(results: List[dict]) -> Dict[str, dict]:
+    """Group comparison rows by suite entry (prefix before the first dot)
+    and report a per-kernel verdict."""
+    groups: Dict[str, dict] = {}
+    for r in results:
+        kernel = r["metric"].split(".", 1)[0]
+        g = groups.setdefault(kernel, {"compared": 0, "regressions": 0})
+        g["compared"] += 1
+        g["regressions"] += int(r["regressed"])
+    return groups
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="compare a fresh bench capture against a baseline")
     ap.add_argument("current", help="fresh telemetry JSONL or suite JSON")
-    ap.add_argument("baseline", help="committed BENCH_*.json or JSONL")
+    ap.add_argument("baseline", nargs="?", default=None,
+                    help="committed BENCH_*.json or JSONL (or use --suite)")
+    ap.add_argument("--suite", default=None,
+                    help="committed per-kernel suite baseline "
+                         "(BENCH_BASELINE.json); results are grouped per "
+                         "kernel entry")
+    ap.add_argument("--kernels", default=None,
+                    help="comma-separated suite entries to gate "
+                         "(e.g. fused_adam_1b,layer_norm)")
     ap.add_argument("--tolerance", type=float, default=0.10,
                     help="allowed relative slowdown (default 0.10 = 10%%)")
     ap.add_argument("--warmup", type=int, default=1,
@@ -164,17 +203,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="restrict the comparison to these metric names")
     args = ap.parse_args(argv)
 
-    for path in (args.current, args.baseline):
+    if (args.baseline is None) == (args.suite is None):
+        print("check_regression: pass exactly one of BASELINE or --suite",
+              file=sys.stderr)
+        return 2
+    baseline_path = args.suite or args.baseline
+
+    for path in (args.current, baseline_path):
         if not os.path.exists(path):
             print(f"check_regression: no such file: {path}",
                   file=sys.stderr)
             return 2
     try:
         current = load_metrics(args.current, args.warmup)
-        baseline = load_metrics(args.baseline, args.warmup)
+        baseline = load_metrics(baseline_path, args.warmup)
     except ValueError as e:
         print(f"check_regression: unparseable input: {e}", file=sys.stderr)
         return 2
+
+    if args.kernels:
+        names = [k.strip() for k in args.kernels.split(",") if k.strip()]
+        current = filter_kernels(current, names)
+        baseline = filter_kernels(baseline, names)
 
     results, skipped = compare(current, baseline, args.tolerance,
                                args.metric)
@@ -186,10 +236,19 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"current={r['current']:g} ratio={r['ratio']:g} "
               f"({r['direction']}-is-better)")
     regressions = [r for r in results if r["regressed"]]
-    print(json.dumps({"compared": len(results),
-                      "regressions": len(regressions),
-                      "skipped": len(skipped),
-                      "tolerance": args.tolerance}))
+    summary = {"compared": len(results),
+               "regressions": len(regressions),
+               "skipped": len(skipped),
+               "tolerance": args.tolerance}
+    if args.suite:
+        per_kernel = summarize_per_kernel(results)
+        for kernel in sorted(per_kernel):
+            g = per_kernel[kernel]
+            tag = "REGRESSION" if g["regressions"] else "OK"
+            print(f"{tag:10s} [{kernel}] {g['compared']} compared, "
+                  f"{g['regressions']} regressions")
+        summary["per_kernel"] = per_kernel
+    print(json.dumps(summary))
     if not results:
         print("check_regression: nothing comparable between the two "
               "captures", file=sys.stderr)

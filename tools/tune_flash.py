@@ -8,6 +8,13 @@ lines survive). Run unbounded in the background — never under `timeout`
 (killing a TPU-holding process wedges the relay).
 
     nohup python -u tools/tune_flash.py > tools/tune_flash.out 2>&1 &
+
+NOTE: the general successor is ``apex-tpu-tune`` (apex_tpu/tune), which
+sweeps the same flash block set (registry._FA_BLOCKS), persists winners to
+the shape-keyed tune cache that ``flash_attention`` consults at trace
+time, and covers the rest of the kernel zoo; this script remains the
+deep-dive harness (fwd+bwd TFLOPs, d=128 point, jax-pallas ceiling
+comparator) whose findings inform the registry's candidate set.
 """
 
 from __future__ import annotations
