@@ -126,8 +126,10 @@ def _make_telemetry_step(batch: int = 8, seq: int = 33, vocab: int = 128,
     return jax.jit(step), state, tokens, float(batch * (seq - 1))
 
 
-def _telemetry_bench(jsonl_path: str, steps: int = 8) -> None:
+def _telemetry_bench(jsonl_path: str, steps: int = 8,
+                     watchdog_timeout: "float | None" = None) -> None:
     """Run the instrumented train loop and stream telemetry to JSONL."""
+    import contextlib
     import json
 
     import jax
@@ -136,6 +138,13 @@ def _telemetry_bench(jsonl_path: str, steps: int = 8) -> None:
 
     step, state, tokens, tokens_per_step = _make_telemetry_step()
     tel = Telemetry(jsonl_path, tokens_per_step=tokens_per_step)
+    # optional collective watchdog: a step that wedges (stuck collective,
+    # straggler host) becomes a collective_stall event in the JSONL —
+    # visible in the capture — instead of a silently hung benchmark
+    wd = None
+    if watchdog_timeout:
+        from apex_tpu.resilience import CollectiveWatchdog
+        wd = CollectiveWatchdog(timeout_s=watchdog_timeout)
     tel.calibrate(step, 0, state, tokens)  # MFU numerator: XLA cost model
     # compile outside the timed window so row 1's step_ms is a step, not
     # the trace+compile
@@ -143,11 +152,16 @@ def _telemetry_bench(jsonl_path: str, steps: int = 8) -> None:
     jax.block_until_ready(tm)
     tel.start()
     for i in range(1, steps + 1):
-        state, tm = step(i, state, tokens)
-        # the loop's ONE host transfer — the overflow flag it needs anyway;
-        # its data dependency also makes step_ms honest wall clock
-        skipped = bool(jax.device_get(tm.found_inf))
+        with (wd.watch("train_step") if wd is not None
+              else contextlib.nullcontext()):
+            state, tm = step(i, state, tokens)
+            # the loop's ONE host transfer — the overflow flag it needs
+            # anyway; its data dependency also makes step_ms honest wall
+            # clock (and gives the watchdog a real completion boundary)
+            skipped = bool(jax.device_get(tm.found_inf))
         tel.log_step(i, metrics=tm, skipped=skipped)
+    if wd is not None:
+        wd.stop()
     tel.close()
     summary = tel.summary()
     print(json.dumps({
@@ -214,7 +228,7 @@ def main() -> None:
     # a structured record instead of a stack trace mid-measurement; there is
     # no step boundary to poll, so the guard raises to unwind immediately
     from apex_tpu.resilience import PreemptionGuard
-    from apex_tpu.utils.logging import structured_warning
+    from apex_tpu.utils.logging import is_rank_zero, publish_event
 
     with PreemptionGuard(raise_on_signal=True) as guard:
         has_telemetry = any(a == "--telemetry-jsonl"
@@ -236,8 +250,12 @@ def main() -> None:
             ap = argparse.ArgumentParser(prog="apex-tpu-bench")
             ap.add_argument("--telemetry-jsonl", required=True)
             ap.add_argument("--steps", type=int, default=8)
+            ap.add_argument("--watchdog-timeout", type=float, default=None,
+                            help="seconds a train step may block before a "
+                                 "collective_stall event lands in the JSONL")
             args, _ = ap.parse_known_args(sys.argv[1:])
-            _telemetry_bench(args.telemetry_jsonl, args.steps)
+            _telemetry_bench(args.telemetry_jsonl, args.steps,
+                             watchdog_timeout=args.watchdog_timeout)
         elif has_subset:
             import argparse
 
@@ -262,9 +280,12 @@ def main() -> None:
             else:
                 _inline_bench()
     if guard.should_stop():
-        structured_warning("bench_preempted",
-                           signal=guard.received_signal,
-                           action="results above this line are complete")
+        # console record on rank 0 only (multi-host bench: one banner);
+        # the bus event fires everywhere for per-host consumers
+        publish_event("bench_preempted", level="warning",
+                      emit=is_rank_zero(),
+                      signal=guard.received_signal,
+                      action="results above this line are complete")
         # a truncated run must not read as a successful benchmark to the
         # caller's exit-code check; keep the conventional signal status
         sys.exit(128 + guard.received_signal)

@@ -36,12 +36,18 @@ STALL_EVENTS = {
     "checkpoint_save_stall": "checkpoint_save",
     "checkpoint_restore_stall": "checkpoint_restore",
     "preemption_unwind": "preemption",
+    # collective watchdog: detection charges the time waited so far, the
+    # cleared record carries the residual — together the cause totals the
+    # actual stall duration of the stuck collective
+    "collective_stall": "collective_stall",
+    "collective_stall_cleared": "collective_stall",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
 COUNTED_EVENTS = (
     "overflow_step_skipped", "overflow_storm", "overflow_storm_cleared",
     "checkpoint_save_retry", "checkpoint_skipped_corrupt",
+    "checkpoint_quarantined", "collective_stall_abort",
     "preemption_requested", "bench_preempted",
 )
 

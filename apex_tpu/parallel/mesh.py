@@ -123,6 +123,30 @@ def make_hybrid_mesh(dcn_axis_sizes: Sequence[int],
     return Mesh(arr, names)
 
 
+def device_process_map(devices, num_processes: int):
+    """Deterministic contiguous-block device→process assignment.
+
+    Real multi-host jax exposes ownership as ``device.process_index``; when
+    a single host *fakes* N processes (the resilience test harness's
+    ``ThreadProcessGroup`` over ``xla_force_host_platform_device_count``
+    CPU devices), this provides the same contract: devices sorted by id are
+    split into ``num_processes`` equal contiguous blocks — the layout
+    TPU slices actually have (each host owns a contiguous chip block), so
+    shard-ownership dedup exercises the production code path. Returns
+    ``{device: process_rank}``.
+    """
+    devs = sorted(devices, key=lambda d: d.id)
+    n = len(devs)
+    if num_processes < 1:
+        raise ValueError(f"num_processes must be >= 1, got {num_processes}")
+    if n % num_processes:
+        raise ValueError(
+            f"{n} devices do not split evenly over {num_processes} "
+            f"processes (fake-process blocks must be equal-sized)")
+    per = n // num_processes
+    return {d: i // per for i, d in enumerate(devs)}
+
+
 def get_mesh(data_axis: str = "data", devices=None) -> Mesh:
     """1-D data-parallel mesh over all local devices (DDP default)."""
     devices = devices if devices is not None else jax.devices()

@@ -1,23 +1,37 @@
 """apex_tpu.resilience — fault-tolerant checkpointing + training resilience.
 
-Three cooperating layers for surviving what production training actually
+Four cooperating layers for surviving what production training actually
 throws at a run:
 
 - :mod:`~apex_tpu.resilience.checkpoint_manager` — step-numbered atomic
   checkpoints with manifests/checksums, retention, retry-with-backoff, and
-  a ``restore_latest`` that skips corrupt/partial steps.
+  a ``restore_latest`` that skips (and quarantines) corrupt/partial steps.
+- :mod:`~apex_tpu.resilience.distributed` — the multi-chip counterpart:
+  :class:`ShardedCheckpointManager` (per-process shard staging, two-phase
+  atomic commit, elastic restore across mesh shapes), the
+  :class:`Coordinator` rendezvous seam, and the :class:`CollectiveWatchdog`
+  that turns hung collectives into ``collective_stall`` events instead of
+  silent stalls.
 - :mod:`~apex_tpu.resilience.preemption` — SIGTERM/SIGINT-aware
-  ``PreemptionGuard`` for save-and-stop on slice eviction.
+  ``PreemptionGuard`` for save-and-stop on slice eviction, with a
+  coordinated mode (any host's signal stops every process at the same
+  step).
 - :mod:`~apex_tpu.resilience.step` + :mod:`~apex_tpu.resilience.fault_injection`
   — overflow-storm guard rails around ``amp.DynamicGradScaler`` and the
   deterministic fault harness that proves all of the above under torn
-  writes, EIO, preemption, and NaN bursts.
+  writes, EIO, preemption, NaN bursts, mid-commit deaths, stragglers, and
+  lost/duplicated shard files.
 
-See docs/robustness.md for the checkpoint layout and semantics.
+See docs/robustness.md for the checkpoint layouts and protocol semantics.
 """
 
 from apex_tpu.resilience.checkpoint_manager import (  # noqa: F401
-    CheckpointCorruptError, CheckpointError, CheckpointManager, Filesystem)
+    CORRUPT_SUFFIX, CheckpointCorruptError, CheckpointError,
+    CheckpointLayoutError, CheckpointManager, Filesystem)
+from apex_tpu.resilience.distributed import (  # noqa: F401
+    CollectiveStallError, CollectiveWatchdog, Coordinator, JaxCoordinator,
+    ShardedCheckpointManager, SingleProcessCoordinator, ThreadProcessGroup,
+    default_coordinator)
 from apex_tpu.resilience.fault_injection import (  # noqa: F401
     FaultInjector, SimulatedCrash)
 from apex_tpu.resilience.preemption import (  # noqa: F401
@@ -26,8 +40,13 @@ from apex_tpu.resilience.step import (  # noqa: F401
     DEFAULT_SCALE_FLOOR, ResilientStep, resilient_step, skip_on_overflow)
 
 __all__ = [
-    "CheckpointCorruptError", "CheckpointError", "CheckpointManager",
-    "Filesystem", "FaultInjector", "SimulatedCrash", "PreemptionGuard",
-    "PreemptionInterrupt", "DEFAULT_SCALE_FLOOR", "ResilientStep",
-    "resilient_step", "skip_on_overflow",
+    "CORRUPT_SUFFIX", "CheckpointCorruptError", "CheckpointError",
+    "CheckpointLayoutError", "CheckpointManager", "Filesystem",
+    "CollectiveStallError",
+    "CollectiveWatchdog", "Coordinator", "JaxCoordinator",
+    "ShardedCheckpointManager", "SingleProcessCoordinator",
+    "ThreadProcessGroup", "default_coordinator", "FaultInjector",
+    "SimulatedCrash", "PreemptionGuard", "PreemptionInterrupt",
+    "DEFAULT_SCALE_FLOOR", "ResilientStep", "resilient_step",
+    "skip_on_overflow",
 ]

@@ -23,7 +23,9 @@ raw serializers in :mod:`apex_tpu.utils.checkpoint`:
 - **restore_latest** — walks committed steps newest-first, validates each
   manifest, and transparently skips corrupt/partial checkpoints, resuming
   from the newest step that verifies. Skips are reported via
-  ``structured_warning`` so a monitoring pipeline sees them.
+  ``structured_warning`` and the corrupt step is quarantined (renamed to
+  ``<step>.corrupt`` with a ``checkpoint_quarantined`` event) so retention
+  only counts steps that verify.
 
 All filesystem access goes through a :class:`Filesystem` seam so the fault
 harness (:mod:`apex_tpu.resilience.fault_injection`) can inject torn writes
@@ -46,7 +48,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
-from apex_tpu.utils.logging import publish_event, structured_warning
+from apex_tpu.utils.logging import (is_rank_zero, publish_event,
+                                    structured_warning)
 
 MANIFEST_NAME = "manifest.json"
 MANIFEST_VERSION = 1
@@ -54,6 +57,7 @@ _STEP_FMT = "step_{:08d}"
 _STEP_RE = re.compile(r"^step_(\d{8})$")
 _TMP_SUFFIX = ".tmp"
 _OLD_SUFFIX = ".old"
+CORRUPT_SUFFIX = ".corrupt"
 
 
 class CheckpointError(RuntimeError):
@@ -62,6 +66,12 @@ class CheckpointError(RuntimeError):
 
 class CheckpointCorruptError(CheckpointError):
     """A checkpoint exists on disk but fails manifest/checksum validation."""
+
+
+class CheckpointLayoutError(CheckpointCorruptError):
+    """A checkpoint is valid but written in a layout this manager cannot
+    assemble (dense vs. sharded). ``restore_latest`` skips it WITHOUT
+    quarantining — the data is fine, the manager is wrong."""
 
 
 class Filesystem:
@@ -146,14 +156,22 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
                  retries: int = 3, backoff_base: float = 0.1,
-                 fs: Optional[Filesystem] = None, sleep=time.sleep):
+                 fs: Optional[Filesystem] = None, sleep=time.sleep,
+                 quarantine_corrupt: bool = True):
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
         self.retries = retries
         self.backoff_base = backoff_base
         self.fs = fs or LOCAL_FS
         self._sleep = sleep
+        self.quarantine_corrupt = quarantine_corrupt
         self.fs.makedirs(self.directory)
+
+    def _is_rank0(self) -> bool:
+        """Which process performs shared-directory mutations (quarantine,
+        prune) and owns console announcements. The single-process manager
+        asks jax; the sharded subclass asks its coordinator."""
+        return is_rank_zero()
 
     # ---- paths ----------------------------------------------------------
     def step_path(self, step: int) -> str:
@@ -269,6 +287,35 @@ class CheckpointManager:
         steps = self.all_steps()
         for s in steps[:max(0, len(steps) - self.max_to_keep)]:
             self.fs.rmtree(self.step_path(s))
+        # quarantined (.corrupt) steps are kept for postmortem but bounded
+        # by the same retention count — they no longer count against the
+        # GOOD-step budget above, which is the whole point of quarantine
+        corrupt = sorted(
+            n for n in names if n.endswith(CORRUPT_SUFFIX)
+            and _STEP_RE.match(n[:-len(CORRUPT_SUFFIX)]))
+        for n in corrupt[:max(0, len(corrupt) - self.max_to_keep)]:
+            self.fs.rmtree(os.path.join(self.directory, n))
+
+    def _quarantine(self, step: int, reason: str) -> None:
+        """Move a checkpoint that failed validation aside (``<step>.corrupt``)
+        so retention only ever counts steps that verify, while the evidence
+        stays on disk for postmortem. Rank 0 performs the rename (the
+        directory is shared); every rank already skipped the step."""
+        if not self.quarantine_corrupt or not self._is_rank0():
+            return
+        src = self.step_path(step)
+        dst = src + CORRUPT_SUFFIX
+        try:
+            if not self.fs.exists(src):
+                return  # already quarantined (or raced away)
+            self.fs.rmtree(dst)
+            self.fs.replace(src, dst)
+        except OSError as e:
+            structured_warning("checkpoint_quarantine_failed",
+                               step=int(step), reason=str(e))
+            return
+        structured_warning("checkpoint_quarantined", step=int(step),
+                           path=dst, reason=reason)
 
     # ---- restore --------------------------------------------------------
     def validate(self, step: int,
@@ -293,6 +340,13 @@ class CheckpointManager:
                 f"{mpath}: bad header (version="
                 f"{manifest.get('format_version')}, "
                 f"step={manifest.get('step')}, expected {step})")
+        if manifest.get("layout") is not None:
+            # a sharded (or future-layout) step: not corrupt, but this
+            # manager cannot assemble it — fail validation cleanly rather
+            # than KeyError mid-restore
+            raise CheckpointLayoutError(
+                f"{mpath}: layout {manifest['layout']!r} requires the "
+                f"matching manager (ShardedCheckpointManager)")
         leaves = manifest.get("leaves")
         if not isinstance(leaves, list) or \
                 len(leaves) != manifest.get("num_leaves"):
@@ -330,8 +384,12 @@ class CheckpointManager:
 
         Corrupt or partial steps (torn write that still got committed, bit
         rot, truncated manifest) are skipped with a ``structured_warning``
-        and the walk continues to the next older step. Returns ``(step,
-        tree)`` or ``None`` when no valid checkpoint exists.
+        and — unless ``quarantine_corrupt=False`` — renamed to
+        ``<step>.corrupt`` (with a ``checkpoint_quarantined`` event) so they
+        stop counting toward ``max_to_keep`` retention: without the rename a
+        run accumulating corrupt steps would silently rotate its *good*
+        checkpoints out while keeping the bad ones. Returns ``(step, tree)``
+        or ``None`` when no valid checkpoint exists.
         """
         t_start = time.perf_counter()
         for step in reversed(self.all_steps()):
@@ -344,4 +402,8 @@ class CheckpointManager:
             except CheckpointCorruptError as e:
                 structured_warning("checkpoint_skipped_corrupt",
                                    step=step, reason=str(e))
+                # layout mismatches skip but never quarantine: the step is
+                # valid data under the OTHER manager, not damage
+                if not isinstance(e, CheckpointLayoutError):
+                    self._quarantine(step, reason=str(e))
         return None

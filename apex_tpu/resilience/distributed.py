@@ -1,0 +1,830 @@
+"""Distributed resilience: sharded elastic checkpoints, coordinated
+preemption, and a collective watchdog.
+
+The PR-1 resilience layer is strictly single-process: ``CheckpointManager``
+serializes the full unsharded tree, ``PreemptionGuard`` acts per host, and
+a hung collective stalls forever undiagnosed. This module is the
+multi-chip counterpart (the TPU analog of the reference's ZeRO +
+NCCL-orchestration pillar):
+
+- :class:`ShardedCheckpointManager` — each process stages only the leaf
+  shards it *owns* (deduced from ``jax.sharding`` device/index maps, with
+  replica dedup), a two-phase commit publishes per-process manifests and
+  then one rank-0 global manifest behind the same atomic
+  ``.tmp`` + ``os.replace`` discipline as PR-1, and **elastic restore**
+  reassembles leaves from shard metadata — save on one mesh shape, restore
+  bit-exact onto another.
+- :class:`Coordinator` — the tiny rendezvous seam (barrier + OR-reduce +
+  device→process map) everything above rides. :class:`JaxCoordinator` is
+  the real multi-host implementation; :class:`ThreadProcessGroup` fakes N
+  processes with N threads so every protocol step is testable on a CPU
+  laptop, stragglers and mid-commit deaths included.
+- :class:`CollectiveWatchdog` — a heartbeat thread that turns "a collective
+  has been stuck for longer than ``timeout_s``" into a structured
+  ``collective_stall`` event (charged to the goodput ledger), an optional
+  all-thread stack dump, and an optional clean abort — instead of an
+  infinite silent hang.
+
+All shared-directory writes stay inside ``<step>.tmp`` staging until the
+single rank-0 ``os.replace`` that commits the step; a kill on ANY host at
+ANY point leaves the previous committed step intact
+(``tools/check_durability.py`` lints this statically, and the
+kill-at-every-write-point property test in
+``tests/test_resilience_distributed.py`` proves it dynamically). The
+checkpoint directory must be shared storage (GCS/NFS) in real multi-host
+runs — the same requirement every sharded-checkpoint system has.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import sys
+import threading
+import time
+import traceback
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from apex_tpu.resilience.checkpoint_manager import (
+    _OLD_SUFFIX, _TMP_SUFFIX, MANIFEST_NAME, MANIFEST_VERSION,
+    CheckpointCorruptError, CheckpointError, CheckpointLayoutError,
+    CheckpointManager)
+from apex_tpu.utils.logging import publish_event, structured_warning
+
+LAYOUT_SHARDED = "sharded"
+PROC_MANIFEST_FMT = "pmanifest_{:05d}.json"
+PROC_MANIFEST_RE = re.compile(r"^pmanifest_(\d{5})\.json$")
+
+
+class CollectiveStallError(RuntimeError):
+    """A collective (barrier/agreement) could not complete: a peer died or
+    exceeded the configured timeout. Raised instead of hanging forever."""
+
+
+# --------------------------------------------------------------------------
+# Coordinator seam
+# --------------------------------------------------------------------------
+
+class Coordinator:
+    """Rendezvous seam for the distributed resilience protocol.
+
+    Three primitives cover everything this module needs:
+
+    - ``barrier(name)`` — all processes arrive before any proceeds;
+    - ``all_any(flag)`` — OR-reduce one bool (the preemption agreement);
+    - ``device_rank(device)`` — which process *owns* a device, used to
+      dedup shard writes (exactly one process writes each unique shard
+      region, chosen from the globally-known device assignment with zero
+      communication).
+
+    Implementations: :class:`SingleProcessCoordinator` (world 1, no-ops),
+    :class:`JaxCoordinator` (real multi-host via
+    ``jax.experimental.multihost_utils``), and the view objects handed out
+    by :class:`ThreadProcessGroup` (N threads faking N processes for
+    tests/CPU).
+    """
+
+    process_index: int = 0
+    process_count: int = 1
+
+    def barrier(self, name: str = "",
+                timeout_s: Optional[float] = None) -> None:
+        raise NotImplementedError
+
+    def all_any(self, flag: bool) -> bool:
+        raise NotImplementedError
+
+    def device_rank(self, device) -> int:
+        return int(getattr(device, "process_index", 0))
+
+
+class SingleProcessCoordinator(Coordinator):
+    """World of one: every primitive degenerates to a local no-op."""
+
+    def barrier(self, name: str = "",
+                timeout_s: Optional[float] = None) -> None:
+        return None
+
+    def all_any(self, flag: bool) -> bool:
+        return bool(flag)
+
+
+class JaxCoordinator(Coordinator):
+    """The real thing: rank/world from the jax runtime, barrier via
+    ``multihost_utils.sync_global_devices``, agreement via a tiny host
+    allgather. On a single-process backend every primitive short-circuits
+    locally (no compilation, no device traffic)."""
+
+    def __init__(self):
+        self.process_index = jax.process_index()
+        self.process_count = jax.process_count()
+
+    def barrier(self, name: str = "",
+                timeout_s: Optional[float] = None) -> None:
+        if self.process_count == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name or "apex_tpu_barrier")
+
+    def all_any(self, flag: bool) -> bool:
+        if self.process_count == 1:
+            return bool(flag)
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([bool(flag)], dtype=np.bool_))
+        return bool(np.any(flags))
+
+
+class ThreadProcessGroup:
+    """N threads standing in for N processes (the CPU test double).
+
+    ``group.coordinator(rank)`` returns rank ``rank``'s view; ``run(fn)``
+    spawns one thread per rank calling ``fn(coordinator, rank)`` and
+    returns per-rank ``(result, exception)`` pairs. Semantics match a real
+    multi-host job where it matters for resilience testing:
+
+    - barriers consult the :class:`~apex_tpu.resilience.fault_injection.
+      FaultInjector` straggler schedule before arriving;
+    - when one "process" dies (raises), the group aborts its barrier so
+      surviving peers get :class:`CollectiveStallError` instead of a
+      forever-hang — what a production job sees when a host disappears;
+    - ``device_rank`` partitions the (single-process) jax devices into
+      contiguous fake-process blocks via
+      :func:`apex_tpu.parallel.mesh.device_process_map`, so shard
+      ownership exercises the same dedup logic real multi-host does.
+    """
+
+    def __init__(self, world: int, *, devices=None, injector=None,
+                 barrier_timeout_s: float = 30.0):
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.world = world
+        self.injector = injector
+        self.barrier_timeout_s = barrier_timeout_s
+        self._barrier = threading.Barrier(world)
+        self._flags = [False] * world
+        from apex_tpu.parallel.mesh import device_process_map
+
+        devs = devices if devices is not None else jax.devices()
+        self._device_rank = {d: r
+                             for d, r in device_process_map(devs,
+                                                            world).items()}
+
+    def coordinator(self, rank: int) -> "_ThreadCoordinator":
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        return _ThreadCoordinator(self, rank)
+
+    def abort(self) -> None:
+        """Break every pending and future barrier wait — a peer died."""
+        self._barrier.abort()
+
+    def run(self, fn) -> List[Tuple[Any, Optional[BaseException]]]:
+        """Run ``fn(coordinator, rank)`` on one thread per rank; a raising
+        rank aborts the group's barriers (peers unblock with
+        :class:`CollectiveStallError`). Returns ``[(result, exc), ...]``
+        indexed by rank."""
+        out: List[Tuple[Any, Optional[BaseException]]] = [
+            (None, None)] * self.world
+
+        def _target(rank: int) -> None:
+            try:
+                out[rank] = (fn(self.coordinator(rank), rank), None)
+            except BaseException as e:  # noqa: BLE001 — reported per rank
+                out[rank] = (None, e)
+                self.abort()
+
+        threads = [threading.Thread(target=_target, args=(r,), daemon=True)
+                   for r in range(self.world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return out
+
+
+class _ThreadCoordinator(Coordinator):
+    def __init__(self, group: ThreadProcessGroup, rank: int):
+        self.group = group
+        self.process_index = rank
+        self.process_count = group.world
+
+    def barrier(self, name: str = "",
+                timeout_s: Optional[float] = None) -> None:
+        inj = self.group.injector
+        if inj is not None:
+            delay = inj.barrier_delay(self.process_index, name)
+            if delay:
+                time.sleep(delay)
+        try:
+            self.group._barrier.wait(
+                timeout_s if timeout_s is not None
+                else self.group.barrier_timeout_s)
+        except threading.BrokenBarrierError:
+            raise CollectiveStallError(
+                f"barrier {name!r} broken on rank {self.process_index}: "
+                f"a peer died or timed out") from None
+
+    def all_any(self, flag: bool) -> bool:
+        self.group._flags[self.process_index] = bool(flag)
+        self.barrier("all_any:write")
+        result = any(self.group._flags)
+        self.barrier("all_any:read")
+        return result
+
+    def device_rank(self, device) -> int:
+        # the fake topology: contiguous device blocks per fake process
+        # (falls back to the real process_index for foreign devices)
+        rank = self.group._device_rank.get(device)
+        return rank if rank is not None else super().device_rank(device)
+
+
+def default_coordinator() -> Coordinator:
+    """The coordinator a production entry point should use: rank/world from
+    the jax runtime (after :func:`apex_tpu.parallel.mesh.init_distributed`),
+    degenerating to free no-ops on a single process."""
+    return JaxCoordinator()
+
+
+# --------------------------------------------------------------------------
+# Collective watchdog
+# --------------------------------------------------------------------------
+
+class CollectiveWatchdog:
+    """Detect stuck collectives/straggler hosts instead of hanging forever.
+
+    A daemon heartbeat thread checks every *watched* region against its
+    deadline. The first breach publishes a structured ``collective_stall``
+    event (console on rank 0, bus everywhere — the goodput ledger charges
+    the ``collective_stall`` cause), then optionally escalates:
+
+    - ``escalate="event"`` (default) — event only; the region keeps
+      waiting (the straggler may still arrive).
+    - ``escalate="dump"`` — also dump every thread's Python stack to
+      stderr, the "where is it stuck" diagnostic a hung job never gives.
+    - ``escalate="abort"`` — dump, then call ``abort_fn`` (default: raise
+      ``SIGABRT`` in this process) so the scheduler restarts the job from
+      the last committed checkpoint rather than burning the reservation on
+      a wedged collective.
+
+    Usage::
+
+        wd = CollectiveWatchdog(timeout_s=300)
+        with wd.watch("allreduce:grads"):
+            psum(...)            # or coordinator.barrier(...)
+        wd.stop()
+
+    When a stalled region eventually completes, a bus-only
+    ``collective_stall_cleared`` event carries the residual lost seconds,
+    so the ledger's ``collective_stall`` cause totals the *actual* stall
+    time, not just the detection threshold.
+    """
+
+    def __init__(self, timeout_s: float = 300.0, *,
+                 poll_s: Optional[float] = None, escalate: str = "event",
+                 abort_fn=None, coordinator: Optional[Coordinator] = None):
+        if escalate not in ("event", "dump", "abort"):
+            raise ValueError(f"escalate must be event|dump|abort, "
+                             f"got {escalate!r}")
+        self.timeout_s = float(timeout_s)
+        self.poll_s = (poll_s if poll_s is not None
+                       else min(max(self.timeout_s / 4.0, 0.005), 1.0))
+        self.escalate = escalate
+        self.abort_fn = abort_fn
+        self.coordinator = coordinator
+        self.stalls: List[Dict[str, Any]] = []
+        self._regions: Dict[int, Dict[str, Any]] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._stop_evt = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ------------------------------------------------------
+    def start(self) -> "CollectiveWatchdog":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop_evt.clear()
+            self._thread = threading.Thread(
+                target=self._heartbeat, name="apex-tpu-collective-watchdog",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "CollectiveWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ---- watched regions ------------------------------------------------
+    def watch(self, name: str, timeout_s: Optional[float] = None):
+        """Context manager: the enclosed blocking region (a barrier, an
+        allreduce, a checkpoint phase) must finish within ``timeout_s``
+        (default: the watchdog's) or the heartbeat reports a stall."""
+        return _WatchedRegion(self, name,
+                              timeout_s if timeout_s is not None
+                              else self.timeout_s)
+
+    def _begin(self, name: str, timeout_s: float) -> int:
+        self.start()
+        now = time.perf_counter()
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._regions[rid] = {"name": name, "t0": now,
+                                  "deadline": now + timeout_s,
+                                  "timeout_s": timeout_s,
+                                  "reported_waited": None}
+        return rid
+
+    def _end(self, rid: int) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            reg = self._regions.pop(rid, None)
+        if reg is not None and reg["reported_waited"] is not None:
+            total = now - reg["t0"]
+            publish_event(
+                "collective_stall_cleared", name=reg["name"],
+                seconds=round(max(0.0, total - reg["reported_waited"]), 6),
+                total_s=round(total, 6))
+
+    # ---- heartbeat ------------------------------------------------------
+    def _rank0(self) -> bool:
+        if self.coordinator is not None:
+            return self.coordinator.process_index == 0
+        from apex_tpu.utils.logging import is_rank_zero
+
+        return is_rank_zero()
+
+    def _heartbeat(self) -> None:
+        while not self._stop_evt.wait(self.poll_s):
+            now = time.perf_counter()
+            breached = []
+            with self._lock:
+                for reg in self._regions.values():
+                    if reg["reported_waited"] is None and \
+                            now > reg["deadline"]:
+                        reg["reported_waited"] = now - reg["t0"]
+                        breached.append(dict(reg))
+            for reg in breached:
+                rec = publish_event(
+                    "collective_stall", level="warning",
+                    emit=self._rank0(), name=reg["name"],
+                    waited_s=round(reg["reported_waited"], 6),
+                    timeout_s=reg["timeout_s"],
+                    seconds=round(reg["reported_waited"], 6),
+                    escalate=self.escalate,
+                    rank=(self.coordinator.process_index
+                          if self.coordinator is not None else 0))
+                self.stalls.append(rec)
+                if self.escalate in ("dump", "abort"):
+                    self._dump_stacks(reg["name"])
+                if self.escalate == "abort":
+                    self._abort(reg["name"])
+
+    def _dump_stacks(self, name: str, stream=None) -> None:
+        """All-thread Python stack dump — the diagnostic a silent hang never
+        yields. Pure-Python (``sys._current_frames``) so it works where
+        faulthandler can't (captured/replaced stderr)."""
+        stream = stream or sys.stderr
+        try:
+            frames = sys._current_frames()
+            print(f"collective_stall[{name}]: dumping "
+                  f"{len(frames)} thread stacks", file=stream)
+            for tid, frame in frames.items():
+                print(f"--- thread {tid} ---", file=stream)
+                traceback.print_stack(frame, file=stream)
+            stream.flush()
+        except Exception:
+            pass  # diagnostics must never take down the watchdog thread
+
+    def _abort(self, name: str) -> None:
+        structured_warning("collective_stall_abort", name=name,
+                           action="aborting so the scheduler restarts from "
+                                  "the last committed checkpoint")
+        if self.abort_fn is not None:
+            self.abort_fn(name)
+        else:
+            import signal as _signal
+
+            os.kill(os.getpid(), _signal.SIGABRT)
+
+
+class _WatchedRegion:
+    def __init__(self, wd: CollectiveWatchdog, name: str, timeout_s: float):
+        self._wd = wd
+        self._name = name
+        self._timeout_s = timeout_s
+        self._rid: Optional[int] = None
+
+    def __enter__(self) -> "_WatchedRegion":
+        self._rid = self._wd._begin(self._name, self._timeout_s)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._rid is not None:
+            self._wd._end(self._rid)
+            self._rid = None
+
+
+# --------------------------------------------------------------------------
+# Sharded checkpoints
+# --------------------------------------------------------------------------
+
+def _leaf_spec(leaf: Any) -> Tuple[Tuple[int, ...], str,
+                                   List[Tuple[Tuple[Tuple[int, int], ...],
+                                              Any]]]:
+    """``(global_shape, dtype_str, regions)`` for one pytree leaf.
+
+    ``regions`` is the deterministic list of ``(region_key, owner_device)``
+    pairs covering the leaf exactly once: every device's index from
+    ``sharding.devices_indices_map`` is normalized to concrete
+    ``(start, stop)`` bounds, replicas of the same region dedup to the
+    lowest device id (globally known — zero communication), and the list is
+    sorted so every process derives identical shard ordinals and file
+    names. Unsharded leaves (plain numpy, single-device arrays) are one
+    full-extent region owned by rank 0 (``owner None``).
+    """
+    shape = tuple(getattr(leaf, "shape", np.asarray(leaf).shape))
+    dtype = str(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is None or not hasattr(sharding, "devices_indices_map"):
+        return shape, dtype, [(tuple((0, d) for d in shape), None)]
+    owners: Dict[Tuple[Tuple[int, int], ...], Any] = {}
+    for dev, idx in sharding.devices_indices_map(shape).items():
+        key = tuple(sl.indices(d)[:2] for sl, d in zip(idx, shape))
+        cur = owners.get(key)
+        if cur is None or dev.id < cur.id:
+            owners[key] = dev
+    return shape, dtype, sorted(owners.items(), key=lambda kv: kv[0])
+
+
+def _region_array(leaf: Any, key: Tuple[Tuple[int, int], ...],
+                  owner: Any) -> np.ndarray:
+    """Host bytes for one owned region — straight off the owner device's
+    shard when addressable (no gather), else sliced from the leaf."""
+    if owner is not None and hasattr(leaf, "addressable_shards"):
+        for sh in leaf.addressable_shards:
+            if sh.device == owner:
+                return np.asarray(sh.data)
+    if not key:
+        return np.asarray(leaf)
+    return np.asarray(leaf[tuple(slice(s, e) for s, e in key)])
+
+
+def _region_size(key: Sequence[Tuple[int, int]]) -> int:
+    n = 1
+    for s, e in key:
+        n *= max(0, e - s)
+    return n
+
+
+class ShardedCheckpointManager(CheckpointManager):
+    """Multi-process sharded checkpoints with two-phase atomic commit and
+    elastic (topology-independent) restore.
+
+    Layout under ``directory`` (shared storage in real multi-host runs)::
+
+        step_00000100/                  # one committed checkpoint
+            manifest.json               # rank-0 global manifest (layout=
+                                        #   sharded, per-leaf shard table)
+            pmanifest_00000.json ...    # one per process: its staged shards
+            leaf_00000.part_000.npy ... # one .npy per unique shard region
+        step_00000200.tmp/              # in-flight staging (never read)
+
+    Commit protocol (``save``):
+
+    1. rank 0 clears and creates ``<step>.tmp``; **barrier**;
+    2. every process writes the shard regions it owns (replica-deduped),
+       then its ``pmanifest_<rank>.json`` — the per-process commit mark;
+       local ``OSError`` retries stay process-local;
+    3. **barrier**, then an ``all_any`` agreement aborts every rank if any
+       rank failed its staging (no half-staged set can ever publish);
+    4. rank 0 aggregates the per-process manifests, validates shard
+       coverage, writes the global ``manifest.json`` into staging, and
+       publishes with ONE ``os.replace`` — the commit point;
+    5. **barrier**, a second agreement propagates a rank-0 publish failure
+       to every rank, then rank 0 prunes retention.
+
+    A kill on any host at any point before step 4's replace leaves only an
+    uncommitted ``.tmp``: ``restore_latest`` still returns the previous
+    committed step. Elastic restore: ``restore(step, like)`` reassembles
+    every leaf from the manifest's shard index metadata — the mesh/process
+    count at save time is irrelevant — and places it with ``like``'s leaf
+    shardings (``jax.make_array_from_callback``), so a tree saved on an
+    8-way mesh restores bit-exact onto 4-way, 1-way, or any other shape.
+    """
+
+    def __init__(self, directory: str, *,
+                 coordinator: Optional[Coordinator] = None,
+                 watchdog: Optional[CollectiveWatchdog] = None, **kw):
+        self.coordinator = (coordinator if coordinator is not None
+                            else default_coordinator())
+        self.watchdog = watchdog
+        super().__init__(directory, **kw)
+
+    # ---- plumbing -------------------------------------------------------
+    def _is_rank0(self) -> bool:
+        return self.coordinator.process_index == 0
+
+    def _barrier(self, name: str) -> None:
+        if self.watchdog is not None:
+            with self.watchdog.watch(name):
+                self.coordinator.barrier(name)
+        else:
+            self.coordinator.barrier(name)
+
+    def _owns(self, owner: Any) -> bool:
+        if owner is None:  # unsharded/host leaf: rank 0 writes it
+            return self._is_rank0()
+        return (self.coordinator.device_rank(owner)
+                == self.coordinator.process_index)
+
+    # ---- save -----------------------------------------------------------
+    def save(self, step: int, tree: Any) -> str:
+        t_start = time.perf_counter()
+        rank = self.coordinator.process_index
+        world = self.coordinator.process_count
+        final = self.step_path(step)
+        tmp = final + _TMP_SUFFIX
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        specs = [_leaf_spec(leaf) for leaf in leaves]
+
+        # phase 0: rank 0 resets staging (a stale .tmp from a crashed save
+        # may carry another attempt's shards); nobody stages before it
+        if rank == 0:
+            self.fs.rmtree(tmp)
+            self.fs.makedirs(tmp)
+        self._barrier(f"ckpt_stage_ready:{step}")
+
+        # phase 1: stage owned shards + the per-process manifest.
+        # Transient OSError retries are process-local (no barrier inside).
+        failed = not self._stage_local(step, tmp, leaves, specs, rank, world)
+        self._barrier(f"ckpt_staged:{step}")
+        if self.coordinator.all_any(failed):
+            raise CheckpointError(
+                f"sharded save for step {step}: staging failed on at least "
+                f"one process (rank {rank} local failure: {failed})")
+
+        # phase 2: rank 0 publishes. The commit point is its single
+        # replace; every other rank learns the outcome via the agreement —
+        # a rank-0 failure must reach the barrier, not bypass it (peers
+        # would hang), so only a simulated-death/BaseException escapes here
+        publish_err: Optional[Exception] = None
+        if rank == 0:
+            try:
+                self._publish(step, tmp, final, specs, world)
+            except (OSError, CheckpointError) as e:
+                structured_warning("checkpoint_publish_failed",
+                                   step=int(step), reason=str(e))
+                publish_err = e
+        self._barrier(f"ckpt_committed:{step}")
+        if self.coordinator.all_any(publish_err is not None):
+            raise CheckpointError(
+                f"sharded save for step {step}: rank 0 failed to publish "
+                f"the global manifest"
+                + (f": {publish_err}" if publish_err is not None else "")
+            ) from publish_err
+
+        if rank == 0:
+            self._prune()
+        publish_event("checkpoint_save_stall", step=int(step),
+                      seconds=round(time.perf_counter() - t_start, 6),
+                      rank=rank)
+        return final
+
+    def _stage_local(self, step: int, tmp: str, leaves: List[Any],
+                     specs: List[Any], rank: int, world: int) -> bool:
+        """Write this process's shards + pmanifest into ``tmp`` staging.
+        Returns True on success; False after exhausting retries (the
+        caller turns that into an all-rank abort — never a raise *before*
+        the barrier, which would leave peers hanging)."""
+        last_err: Optional[OSError] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                delay = self.backoff_base * (2.0 ** (attempt - 1))
+                structured_warning(
+                    "checkpoint_save_retry", step=int(step), rank=rank,
+                    attempt=attempt, delay_s=delay, error=str(last_err))
+                self._sleep(delay)
+            try:
+                self.fs.makedirs(tmp)
+                entries = []
+                for i, (leaf, (shape, dtype, regions)) in enumerate(
+                        zip(leaves, specs)):
+                    for ordinal, (key, owner) in enumerate(regions):
+                        if not self._owns(owner):
+                            continue
+                        # one serialized blob live at a time (same RAM
+                        # discipline as the single-process manager)
+                        buf = io.BytesIO()
+                        np.save(buf, _region_array(leaf, key, owner),
+                                allow_pickle=False)
+                        blob = buf.getvalue()
+                        entry = {
+                            "leaf": i,
+                            "file": f"leaf_{i:05d}.part_{ordinal:03d}.npy",
+                            "index": [list(se) for se in key],
+                            "nbytes": len(blob),
+                            "crc32": zlib.crc32(blob),
+                        }
+                        self.fs.write_bytes(os.path.join(tmp, entry["file"]),
+                                            blob)
+                        entries.append(entry)
+                pmanifest = {
+                    "format_version": MANIFEST_VERSION,
+                    "layout": LAYOUT_SHARDED,
+                    "step": int(step),
+                    "process": rank,
+                    "world": world,
+                    "shards": entries,
+                }
+                # pmanifest last: its presence marks this process's shards
+                # as fully staged (the per-process commit mark)
+                self.fs.write_bytes(
+                    os.path.join(tmp, PROC_MANIFEST_FMT.format(rank)),
+                    json.dumps(pmanifest, indent=1).encode())
+                return True
+            except OSError as e:
+                last_err = e
+        return False
+
+    def _publish(self, step: int, tmp: str, final: str, specs: List[Any],
+                 world: int) -> None:
+        """Rank 0: aggregate per-process manifests, validate coverage,
+        write the global manifest into staging, publish atomically."""
+        leaves_meta: List[Dict[str, Any]] = [
+            {"shape": list(shape), "dtype": dtype, "shards": []}
+            for shape, dtype, _ in specs]
+        for r in range(world):
+            ppath = os.path.join(tmp, PROC_MANIFEST_FMT.format(r))
+            if not self.fs.exists(ppath):
+                raise CheckpointError(
+                    f"step {step}: process {r} staged no manifest "
+                    f"(died before its per-process commit?)")
+            pm = json.loads(self.fs.read_bytes(ppath))
+            if pm.get("step") != step or pm.get("world") != world:
+                raise CheckpointError(
+                    f"{ppath}: stale staging (step={pm.get('step')}, "
+                    f"world={pm.get('world')}, expected {step}/{world})")
+            for ent in pm["shards"]:
+                leaves_meta[ent["leaf"]]["shards"].append(
+                    {k: ent[k] for k in ("file", "index", "nbytes",
+                                         "crc32")})
+        for i, ((shape, _dtype, _regions), meta) in enumerate(
+                zip(specs, leaves_meta)):
+            total = int(np.prod(shape)) if shape else 1
+            covered = sum(_region_size(ent["index"])
+                          for ent in meta["shards"])
+            if covered != total:
+                raise CheckpointError(
+                    f"step {step} leaf {i}: shard coverage {covered}/"
+                    f"{total} elements — a process staged too few or too "
+                    f"many shards")
+        manifest = {
+            "format_version": MANIFEST_VERSION,
+            "layout": LAYOUT_SHARDED,
+            "step": int(step),
+            "created": time.time(),
+            "world": world,
+            "num_leaves": len(specs),
+            "leaves": leaves_meta,
+        }
+        # manifest last inside staging, then the one atomic publish; a
+        # re-save of an existing step moves the old commit aside by rename
+        # (never rmtree before the commit point — same discipline and
+        # failure analysis as the single-process manager)
+        self.fs.write_bytes(os.path.join(tmp, MANIFEST_NAME),
+                            json.dumps(manifest, indent=1).encode())
+        old = final + _OLD_SUFFIX
+        if self.fs.exists(final):
+            self.fs.rmtree(old)
+            self.fs.replace(final, old)
+        self.fs.replace(tmp, final)  # THE commit point
+        self.fs.sync_dir(self.directory)
+        self.fs.rmtree(old)
+
+    # ---- restore --------------------------------------------------------
+    def validate(self, step: int,
+                 _blobs: Optional[Dict[str, bytes]] = None) -> Dict[str, Any]:
+        """Parse + verify the global manifest and every shard's checksum.
+        Also proves per-leaf coverage is exact (a lost shard file reads as
+        a gap, a duplicated region as overlap — both corrupt, both
+        quarantinable), so a damaged step can never half-restore."""
+        path = self.step_path(step)
+        mpath = os.path.join(path, MANIFEST_NAME)
+        if not self.fs.exists(mpath):
+            raise CheckpointCorruptError(f"{path}: missing {MANIFEST_NAME}")
+        try:
+            manifest = json.loads(self.fs.read_bytes(mpath))
+        except (ValueError, OSError) as e:
+            raise CheckpointCorruptError(f"{mpath}: unreadable manifest "
+                                         f"({e})") from e
+        if manifest.get("format_version") != MANIFEST_VERSION or \
+                manifest.get("step") != step:
+            raise CheckpointCorruptError(
+                f"{mpath}: bad header (version="
+                f"{manifest.get('format_version')}, "
+                f"step={manifest.get('step')}, expected {step})")
+        if manifest.get("layout") != LAYOUT_SHARDED:
+            # a dense (single-process) step: valid data under the base
+            # manager — skip without quarantining
+            raise CheckpointLayoutError(
+                f"{mpath}: layout {manifest.get('layout')!r} requires the "
+                f"dense CheckpointManager")
+        leaves = manifest.get("leaves")
+        if not isinstance(leaves, list) or \
+                len(leaves) != manifest.get("num_leaves"):
+            raise CheckpointCorruptError(f"{mpath}: leaf table truncated")
+        for li, leaf in enumerate(leaves):
+            shape = tuple(leaf["shape"])
+            total = int(np.prod(shape)) if shape else 1
+            covered = 0
+            seen = set()
+            for ent in leaf["shards"]:
+                key = tuple(tuple(se) for se in ent["index"])
+                if key in seen:
+                    raise CheckpointCorruptError(
+                        f"{path} leaf {li}: duplicated shard region {key}")
+                seen.add(key)
+                covered += _region_size(key)
+                fpath = os.path.join(path, ent["file"])
+                if not self.fs.exists(fpath):
+                    raise CheckpointCorruptError(
+                        f"{fpath}: missing shard file (lost after commit)")
+                data = self.fs.read_bytes(fpath)
+                if len(data) != ent["nbytes"] or \
+                        zlib.crc32(data) != ent["crc32"]:
+                    raise CheckpointCorruptError(
+                        f"{fpath}: checksum mismatch (torn, corrupt, or "
+                        f"duplicated-over write)")
+                if _blobs is not None:
+                    _blobs[ent["file"]] = data
+            if covered != total:
+                raise CheckpointCorruptError(
+                    f"{path} leaf {li}: shard coverage {covered}/{total} "
+                    f"elements (lost shard file)")
+        return manifest
+
+    def restore(self, step: int, like: Any) -> Any:
+        """Validated elastic restore into the structure *and topology* of
+        ``like``: leaves reassemble from shard index metadata (whatever
+        mesh they were saved under) and are placed with each ``like``
+        leaf's sharding — bit-exact across mesh shapes and process
+        counts."""
+        blobs: Dict[str, bytes] = {}
+        manifest = self.validate(step, _blobs=blobs)
+        refs, treedef = jax.tree_util.tree_flatten(like)
+        if len(refs) != manifest["num_leaves"]:
+            raise CheckpointCorruptError(
+                f"{self.step_path(step)}: has {manifest['num_leaves']} "
+                f"leaves, restore target has {len(refs)}")
+        out = [self._assemble_leaf(meta, blobs, ref)
+               for meta, ref in zip(manifest["leaves"], refs)]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _assemble_leaf(self, meta: Dict[str, Any], blobs: Dict[str, bytes],
+                       ref: Any) -> Any:
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(getattr(ref, "dtype", None)
+                         or np.asarray(ref).dtype)
+        buf = np.zeros(shape, dtype=dtype)
+        for ent in meta["shards"]:
+            arr = np.load(io.BytesIO(blobs.pop(ent["file"])),
+                          allow_pickle=False)
+            if arr.dtype != buf.dtype:
+                if arr.dtype.kind == "V":
+                    # extension dtypes (bfloat16, fp8) round-trip as raw
+                    # bytes; re-view through the restore target's dtype
+                    arr = arr.view(buf.dtype)
+                else:
+                    raise CheckpointCorruptError(
+                        f"{ent['file']}: dtype {arr.dtype} does not match "
+                        f"restore target {buf.dtype}")
+            sl = tuple(slice(s, e) for s, e in ent["index"])
+            if sl:
+                buf[sl] = arr
+            else:
+                buf[()] = arr
+        sharding = getattr(ref, "sharding", None)
+        if sharding is not None and hasattr(sharding, "devices_indices_map"):
+            # only the addressable pieces materialize on device — in a real
+            # multi-host restore each process places just its own shards
+            # (np.asarray, not ascontiguousarray: the latter promotes 0-d
+            # scalars to 1-d and the shard shapes stop matching)
+            return jax.make_array_from_callback(
+                shape, sharding, lambda idx: np.asarray(buf[idx]))
+        return jax.numpy.asarray(buf)

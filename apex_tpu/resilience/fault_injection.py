@@ -11,6 +11,15 @@ Production failure modes, reproduced on a laptop with a seed:
 - **Preemption** — ``fire_preemption()`` delivers a real SIGTERM to this
   process so :class:`~apex_tpu.resilience.preemption.PreemptionGuard` runs
   the same code path the scheduler triggers.
+- **Distributed scenarios** — ``crash_on_write(pattern)`` kills the
+  "process" the moment it touches a matching path (death between the
+  per-process shard commit and the global-manifest publish = pattern on
+  the global manifest), ``crash_on_replace(pattern)`` dies just before the
+  atomic publish itself, ``drop_write(pattern)`` silently loses a shard
+  file's bytes, ``straggler(rank, delay_s)`` delays one fake process's
+  barrier arrival (what a hung host looks like to the collective
+  watchdog), and ``lose_shard``/``duplicate_shard`` corrupt a *committed*
+  sharded checkpoint in place.
 - **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
   window of steps whose gradients ``poison_grads`` fills with NaN/Inf
   (choice seeded), reproducing the overflow storms that collapse a dynamic
@@ -26,6 +35,8 @@ from __future__ import annotations
 import errno
 import os
 import random
+import re
+import shutil
 import signal
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -60,16 +71,32 @@ class _InjectedFilesystem(Filesystem):
         self._injector = injector
 
     def write_bytes(self, path: str, data: bytes) -> None:
-        fault = self._injector._next_write_fault()
-        if fault is None:
-            return super().write_bytes(path, data)
-        if fault.kind == "error":
-            raise OSError(fault.err, os.strerror(fault.err), path)
-        # torn write: a prefix reaches the disk, then the process "dies"
-        keep = int(len(data) * fault.fraction)
-        super().write_bytes(path, data[:keep])
-        raise SimulatedCrash(
-            f"torn write: {keep}/{len(data)} bytes of {path}")
+        inj = self._injector
+        fault = inj._next_write_fault()
+        if fault is not None:
+            if fault.kind == "error":
+                raise OSError(fault.err, os.strerror(fault.err), path)
+            # torn write: a prefix reaches the disk, then the process "dies"
+            keep = int(len(data) * fault.fraction)
+            super().write_bytes(path, data[:keep])
+            raise SimulatedCrash(
+                f"torn write: {keep}/{len(data)} bytes of {path}")
+        if inj._matches(inj._crash_write_patterns, path):
+            # the process dies the instant it reaches this file — nothing
+            # of it lands on disk (e.g. between the per-process shard
+            # commit and the global-manifest publish)
+            raise SimulatedCrash(f"process died before writing {path}")
+        if inj._matches(inj._drop_write_patterns, path):
+            return  # the bytes silently vanish: a lost shard file
+        super().write_bytes(path, data)
+
+    def replace(self, src: str, dst: str) -> None:
+        if self._injector._matches(self._injector._crash_replace_patterns,
+                                   dst):
+            # death at the commit point itself: staging is complete but the
+            # atomic publish never happened
+            raise SimulatedCrash(f"process died before replace -> {dst}")
+        super().replace(src, dst)
 
 
 class FaultInjector:
@@ -80,6 +107,10 @@ class FaultInjector:
         self._write_calls = 0
         self._write_faults: Dict[int, _WriteFault] = {}
         self._bursts: List[Tuple[int, int]] = []
+        self._crash_write_patterns: List[re.Pattern] = []
+        self._drop_write_patterns: List[re.Pattern] = []
+        self._crash_replace_patterns: List[re.Pattern] = []
+        self._stragglers: List[List[Any]] = []  # [rank, name|None, delay_s]
 
     # ---- filesystem faults ---------------------------------------------
     def filesystem(self) -> Filesystem:
@@ -107,6 +138,87 @@ class FaultInjector:
     def _next_write_fault(self) -> Optional[_WriteFault]:
         self._write_calls += 1
         return self._write_faults.pop(self._write_calls, None)
+
+    @staticmethod
+    def _matches(patterns: List[re.Pattern], path: str) -> bool:
+        # match against a normalized path so patterns work across platforms
+        norm = path.replace(os.sep, "/")
+        return any(p.search(norm) for p in patterns)
+
+    # ---- distributed: crash points --------------------------------------
+    def crash_on_write(self, pattern: str) -> "FaultInjector":
+        """Raise :class:`SimulatedCrash` the moment a write targets a path
+        matching ``pattern`` (regex, ``/``-normalized) — nothing of that
+        file reaches disk. With the sharded manager, ``r"/manifest\\.json$"``
+        is exactly "the process died after committing its own shards but
+        before the global-manifest publish"."""
+        self._crash_write_patterns.append(re.compile(pattern))
+        return self
+
+    def crash_on_replace(self, pattern: str) -> "FaultInjector":
+        """Raise :class:`SimulatedCrash` just before an ``os.replace`` whose
+        destination matches ``pattern`` — death at the commit point with a
+        fully staged ``.tmp`` on disk."""
+        self._crash_replace_patterns.append(re.compile(pattern))
+        return self
+
+    def drop_write(self, pattern: str) -> "FaultInjector":
+        """Silently discard writes to paths matching ``pattern`` — the
+        caller believes the shard landed; restore finds it missing."""
+        self._drop_write_patterns.append(re.compile(pattern))
+        return self
+
+    # ---- distributed: stragglers ----------------------------------------
+    def straggler(self, rank: int, delay_s: float,
+                  name: Optional[str] = None) -> "FaultInjector":
+        """Delay fake-process ``rank``'s next barrier arrival by
+        ``delay_s`` (optionally only a barrier whose name contains
+        ``name``) — the stuck-host signature the collective watchdog must
+        surface. One-shot: each scheduled delay fires once."""
+        self._stragglers.append([rank, name, delay_s])
+        return self
+
+    def barrier_delay(self, rank: int, name: str = "") -> float:
+        """Consumed by coordinator barriers: seconds this rank should lag
+        behind its peers before arriving at ``name``."""
+        for ent in self._stragglers:
+            if ent[0] == rank and (ent[1] is None or ent[1] in name):
+                self._stragglers.remove(ent)
+                return float(ent[2])
+        return 0.0
+
+    # ---- distributed: committed-checkpoint damage -----------------------
+    @staticmethod
+    def _shard_files(ckpt_dir: str, match: str) -> List[str]:
+        names = sorted(n for n in os.listdir(ckpt_dir)
+                       if re.search(match, n) and not n.endswith(".json"))
+        return [os.path.join(ckpt_dir, n) for n in names]
+
+    def lose_shard(self, ckpt_dir: str, match: str = r"leaf_") -> str:
+        """Delete one committed shard file (bit-rot/eviction after commit).
+        Returns the removed path; restore must detect the gap."""
+        files = self._shard_files(ckpt_dir, match)
+        if not files:
+            raise ValueError(f"no shard files matching {match!r} in "
+                             f"{ckpt_dir}")
+        victim = files[self.rng.randrange(len(files))]
+        os.remove(victim)
+        return victim
+
+    def duplicate_shard(self, ckpt_dir: str,
+                        match: str = r"leaf_") -> Tuple[str, str]:
+        """Overwrite one shard file with a *different* shard's bytes (a
+        misdirected retry / duplicated object) — same file present, wrong
+        content. Returns ``(src, clobbered)``; the checksum must catch it.
+        """
+        files = self._shard_files(ckpt_dir, match)
+        if len(files) < 2:
+            raise ValueError(f"need >= 2 shard files matching {match!r} in "
+                             f"{ckpt_dir}")
+        i = self.rng.randrange(len(files) - 1)
+        src, dst = files[i], files[i + 1]
+        shutil.copyfile(src, dst)
+        return src, dst
 
     # ---- preemption -----------------------------------------------------
     def fire_preemption(self, sig: int = signal.SIGTERM) -> None:

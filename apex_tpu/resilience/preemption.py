@@ -7,6 +7,15 @@ I/O in handler context), the training loop polls ``should_stop()`` at step
 boundaries, and ``finalize()`` runs the registered final synchronous save
 exactly once. A second signal restores default handling so an operator's
 repeated Ctrl-C still kills a wedged process.
+
+Multi-host runs get a **coordinated** mode: pass a
+:class:`~apex_tpu.resilience.distributed.Coordinator` and ``should_stop()``
+becomes a tiny agreement collective — a SIGTERM delivered to ANY host makes
+*every* process return True at the same step boundary, so all hosts enter
+the same final sharded save together instead of one host saving step N
+while another saves N+1 (which a sharded checkpoint could never commit).
+Console announcements are gated to rank 0; the structured
+``preemption_requested`` bus event still fires on every rank.
 """
 
 from __future__ import annotations
@@ -16,7 +25,9 @@ import signal
 import threading
 from typing import Callable, Iterable, Optional
 
-from apex_tpu.utils.logging import structured_warning
+from apex_tpu.utils.logging import is_rank_zero, publish_event
+
+_PROGRAMMATIC = -1  # request_stop() with no signal attached
 
 
 class PreemptionInterrupt(BaseException):
@@ -55,16 +66,29 @@ class PreemptionGuard:
     one-shot export), ``raise_on_signal=True`` makes the handler raise
     :class:`PreemptionInterrupt` in the main thread instead — the ``with``
     body unwinds immediately and ``__exit__`` still runs ``on_preempt``.
+
+    **Coordinated (distributed) mode** — with ``coordinator`` set and a
+    world size > 1, every ``should_stop()`` call is a collective: the
+    local stop flag is OR-reduced across processes, so the whole job
+    agrees on the same stop step no matter which host the scheduler
+    signalled. All processes must therefore poll ``should_stop()`` at the
+    same step cadence (it is a collective, like any other). Once agreement
+    is reached the result is cached — later calls (``__exit__``,
+    ``finalize``) are local and cheap. ``request_stop()`` feeds the same
+    path programmatically (an orchestrator's drain command, or a test).
     """
 
     def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
                                                  signal.SIGINT),
                  on_preempt: Optional[Callable[[], None]] = None,
-                 raise_on_signal: bool = False):
+                 raise_on_signal: bool = False,
+                 coordinator=None):
         self.signals = tuple(signals)
         self.on_preempt = on_preempt
         self.raise_on_signal = raise_on_signal
+        self.coordinator = coordinator
         self._stop = threading.Event()
+        self._agreed = False
         self._finalized = False
         self._announced = False
         self._received: Optional[int] = None
@@ -88,8 +112,9 @@ class PreemptionGuard:
                 except (ValueError, OSError):
                     pass
             self._prev.clear()
-            structured_warning(
-                "preemption_guard_inert",
+            publish_event(
+                "preemption_guard_inert", level="warning",
+                emit=self._rank0(),
                 reason="signal handlers require the main thread and valid "
                        "signal numbers")
         return self
@@ -132,22 +157,60 @@ class PreemptionGuard:
             self.restore()
             os.kill(os.getpid(), signum)
 
+    def request_stop(self, signum: int = _PROGRAMMATIC) -> None:
+        """Programmatic preemption: an orchestrator's drain command (or a
+        test's fake signal) follows the exact save-and-stop path a SIGTERM
+        does — including the cross-process agreement in coordinated mode."""
+        if self._received is None:
+            self._received = signum
+        self._stop.set()
+
+    def _rank0(self) -> bool:
+        if self.coordinator is not None:
+            return self.coordinator.process_index == 0
+        return is_rank_zero()
+
     def _announce(self) -> None:
-        if self._announced or self._received is None:
+        if self._announced or not self._stop.is_set():
             return
         self._announced = True
-        structured_warning("preemption_requested",
-                           signal=int(self._received),
-                           action="finishing step, then final save")
+        # console banner on rank 0 only (an N-host preemption must not
+        # print N interleaved banners); the bus record fires on every rank
+        # so per-host consumers (goodput ledger, JSONL mirror) all see it
+        publish_event(
+            "preemption_requested", level="warning", emit=self._rank0(),
+            signal=(int(self._received)
+                    if self._received is not None else None),
+            origin=("peer" if self._received is None else
+                    "request_stop" if self._received == _PROGRAMMATIC
+                    else "signal"),
+            action="finishing step, then final save")
 
     # ---- loop API -------------------------------------------------------
     def should_stop(self) -> bool:
-        """True once a preemption signal has been received (cheap; poll
-        every step)."""
-        if self._stop.is_set():
+        """True once a preemption has been agreed (cheap; poll every step).
+
+        Local mode: true once this process received a signal. Coordinated
+        mode (``coordinator`` with world > 1): a collective OR of every
+        process's local flag — all processes flip to True at the same call,
+        and the agreed result is cached so only pre-agreement polls pay the
+        (tiny) collective.
+        """
+        if self._agreed:
             self._announce()
             return True
-        return False
+        local = self._stop.is_set()
+        coord = self.coordinator
+        if coord is not None and coord.process_count > 1:
+            stop = bool(coord.all_any(local))
+            if stop and not local:
+                self._stop.set()  # peer-initiated; _received stays None
+        else:
+            stop = local
+        if stop:
+            self._agreed = True
+            self._announce()
+        return stop
 
     @property
     def received_signal(self) -> Optional[int]:

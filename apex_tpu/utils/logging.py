@@ -72,6 +72,23 @@ def publish_event(event: str, *, level: str = "info", stream=None,
     return rec
 
 
+def is_rank_zero() -> bool:
+    """True on the process that owns console output (jax process 0).
+
+    Multihost components gate their *console* announcements through this so
+    an N-host event prints one banner, not N interleaved ones — the bus
+    record (``publish_event``) still fires on every rank for per-host
+    consumers (goodput ledgers, JSONL mirrors). Degrades to True when no
+    backend is reachable, so single-process tools keep printing.
+    """
+    try:
+        import jax  # deferred: logging must stay importable without a backend
+
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
 def deprecated_warning(msg: str) -> None:
     """apex.deprecated_warning parity (apex/__init__.py:37-43): emit once per
     distinct message. FutureWarning, as in the reference's

@@ -12,7 +12,12 @@ Run (CPU or TPU):
 With ``--ckpt-dir`` the loop becomes preemptible: it resumes from the
 newest valid checkpoint, saves every ``--save-every`` steps through the
 atomic CheckpointManager, and a SIGTERM/SIGINT triggers one final
-synchronous save before exit (docs/robustness.md).
+synchronous save before exit (docs/robustness.md). ``--sharded-ckpt``
+swaps in the distributed ShardedCheckpointManager: each process stages
+only the shards it owns, preemption is agreed across processes (every
+host saves the same step), and ``--watchdog-timeout`` arms the collective
+watchdog over the commit barriers — all degenerate to the single-process
+behavior on one host, so the same flag works from laptop to pod.
 
 With ``--telemetry-jsonl PATH`` every step emits a telemetry row
 (``{step, loss, grad_norm, loss_scale, step_ms, tokens_per_s, mfu, ...}``)
@@ -45,6 +50,12 @@ def main():
     ap.add_argument("--ckpt-dir", type=str, default=None,
                     help="enable resumable checkpointing into this dir")
     ap.add_argument("--save-every", type=int, default=2)
+    ap.add_argument("--sharded-ckpt", action="store_true",
+                    help="use the distributed ShardedCheckpointManager "
+                         "(two-phase commit, coordinated preemption)")
+    ap.add_argument("--watchdog-timeout", type=float, default=None,
+                    help="collective watchdog timeout in seconds (with "
+                         "--sharded-ckpt)")
     ap.add_argument("--telemetry-jsonl", type=str, default=None,
                     help="emit per-step telemetry rows to this JSONL file")
     args = ap.parse_args()
@@ -98,15 +109,33 @@ def main():
                               tokens_per_step=args.batch * args.seq)
         telemetry.calibrate(grads_of, params)
 
-    # optional resilience: resumable atomic checkpoints + preemption guard
-    manager = guard = None
+    # optional resilience: resumable atomic checkpoints + preemption guard.
+    # Console banners are rank-0 gated: an N-host run prints one resume/
+    # preempt line, not N interleaved ones (bus events fire on every rank).
+    rank0 = jax.process_index() == 0
+    manager = guard = watchdog = None
     start_step = 0
     if args.ckpt_dir:
         import numpy as np
 
         from apex_tpu.resilience import CheckpointManager, PreemptionGuard
-        manager = CheckpointManager(args.ckpt_dir, max_to_keep=2)
-        guard = PreemptionGuard().install()
+        if args.sharded_ckpt:
+            from apex_tpu.resilience import (CollectiveWatchdog,
+                                             ShardedCheckpointManager,
+                                             default_coordinator)
+            coord = default_coordinator()
+            if args.watchdog_timeout:
+                watchdog = CollectiveWatchdog(
+                    timeout_s=args.watchdog_timeout, coordinator=coord)
+            manager = ShardedCheckpointManager(
+                args.ckpt_dir, max_to_keep=2, coordinator=coord,
+                watchdog=watchdog)
+            # coordinated: a SIGTERM on ANY host stops every process at
+            # the same step, so the final sharded save can commit
+            guard = PreemptionGuard(coordinator=coord).install()
+        else:
+            manager = CheckpointManager(args.ckpt_dir, max_to_keep=2)
+            guard = PreemptionGuard().install()
         like = {"params": params, "opt": opt.state_dict(), "step": 0}
         restored = manager.restore_latest(like)
         if restored is not None:
@@ -115,7 +144,8 @@ def main():
             opt.load_state_dict(jax.tree_util.tree_map(np.asarray,
                                                        tree["opt"]))
             start_step = int(tree["step"]) + 1
-            print(f"resumed from step {start_step - 1}", flush=True)
+            if rank0:
+                print(f"resumed from step {start_step - 1}", flush=True)
 
     def save(step, params):
         manager.save(step, {"params": params, "opt": opt.state_dict(),
@@ -139,11 +169,15 @@ def main():
                 save(step, params)  # save stalls land in the goodput ledger
             if guard is not None and guard.should_stop():
                 save(step, params)  # final synchronous save, then stop
-                print(f"preempted: saved step {step}, exiting", flush=True)
+                if rank0:
+                    print(f"preempted: saved step {step}, exiting",
+                          flush=True)
                 return
     finally:
         if guard is not None:
             guard.restore()
+        if watchdog is not None:
+            watchdog.stop()
         if telemetry is not None:
             telemetry.close()
             import json

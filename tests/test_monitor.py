@@ -178,6 +178,28 @@ def test_goodput_ledger_subscribes_to_stall_events():
     assert s["events"]["checkpoint_save_stall"] == 1
 
 
+def test_distributed_resilience_events_registered():
+    """The distributed-resilience events are part of the telemetry schema:
+    collective_stall (+cleared) is a timed goodput cause, quarantine and
+    watchdog aborts are counted degradation signals."""
+    from apex_tpu.monitor.goodput import COUNTED_EVENTS, STALL_EVENTS
+
+    assert STALL_EVENTS["collective_stall"] == "collective_stall"
+    assert STALL_EVENTS["collective_stall_cleared"] == "collective_stall"
+    assert "checkpoint_quarantined" in COUNTED_EVENTS
+    assert "collective_stall_abort" in COUNTED_EVENTS
+
+    with GoodputLedger() as led:
+        publish_event("collective_stall", name="allreduce", seconds=0.5)
+        publish_event("collective_stall_cleared", name="allreduce",
+                      seconds=0.25)
+        publish_event("checkpoint_quarantined", step=3, reason="crc")
+    s = led.summary()
+    assert s["lost_by_cause"]["collective_stall"] == pytest.approx(0.75)
+    assert s["events"]["checkpoint_quarantined"] == 1
+    assert s["events"]["collective_stall"] == 1
+
+
 def test_checkpoint_save_publishes_stall_event(tmp_path):
     # call-time imports for BOTH sides: test_chip_worker's module purge can
     # leave collection-time and re-imported apex_tpu identities coexisting,

@@ -15,6 +15,16 @@ Scope (kept deliberately narrow to stay false-positive-free):
 - functions whose name contains save/checkpoint/ckpt/manifest anywhere in
   ``apex_tpu/``.
 
+Sharded-checkpoint paths (``resilience/distributed``) get two stricter
+rules on top — the two-phase commit's whole crash-safety argument rests on
+them:
+- EVERY write (the ``Filesystem.write_bytes`` seam included) must sit in a
+  function that visibly stages into ``.tmp`` — a write landing outside
+  staging would be observable before the commit point;
+- the publish must go through ``replace`` — ``os.rename``/``shutil.move``
+  anywhere in checkpoint-flavored code is flagged (non-atomic or
+  cross-filesystem-copy semantics).
+
 Exit status: 0 clean, 1 on violations (listed one per line). Run as
 ``python tools/check_durability.py`` from the repo root; the tier-1 suite
 runs it (tests/test_resilience.py) so new violations fail CI.
@@ -38,6 +48,13 @@ SAFE_MARKERS = (".tmp", "os.replace")
 SAFE_CALL_HINTS = ("BytesIO", "write_bytes", "StringIO")
 ALLOWED_FUNCS = {"write_bytes"}  # the seam's own implementation
 
+# sharded-checkpoint modules: the stricter ruleset applies
+SHARDED_PATH_HINTS = (os.path.join("resilience", "distributed"),)
+# evidence a sharded write targets the .tmp staging dir
+STAGING_MARKERS = (".tmp", "_TMP_SUFFIX")
+# non-atomic publish calls: (module attr, call name)
+RENAME_CALLS = {("os", "rename"), ("shutil", "move")}
+
 
 def _is_write_call(node: ast.Call) -> bool:
     f = node.func
@@ -57,13 +74,60 @@ def _is_write_call(node: ast.Call) -> bool:
     return False
 
 
+def _is_seam_write(node: ast.Call) -> bool:
+    """A write through the Filesystem seam (``*.write_bytes(...)``) — safe
+    in ordinary checkpoint code, but in sharded modules it must still
+    target ``.tmp`` staging."""
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr == "write_bytes"
+
+
+def _is_rename_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and (f.value.id, f.attr) in RENAME_CALLS)
+
+
+def _path_arg_staged(node: ast.Call) -> bool:
+    """True when the write's path argument visibly derives from a staging
+    variable (``tmp``/``staging``) — e.g. ``os.path.join(tmp, name)`` —
+    the strongest static evidence the bytes land inside the staging dir."""
+    if not node.args:
+        return False
+    for sub in ast.walk(node.args[0]):
+        if isinstance(sub, ast.Name) and (
+                "tmp" in sub.id.lower() or "staging" in sub.id.lower()):
+            return True
+    return False
+
+
+def _writes_to_path(node: ast.Call) -> bool:
+    """Distinguish a filesystem write from a serialize-into-buffer: np.save
+    into an ``io.BytesIO`` (a bare buffer Name) is in-memory; a string
+    constant, f-string, concatenation, ``os.path.join(...)`` or a
+    path-flavored variable name is a real destination."""
+    if isinstance(node.func, ast.Name):  # open(...) — arg IS the path
+        return True
+    if not node.args:
+        return False
+    arg = node.args[0]
+    if isinstance(arg, (ast.Constant, ast.JoinedStr, ast.BinOp, ast.Call)):
+        return True
+    if isinstance(arg, ast.Name):
+        return any(h in arg.id.lower()
+                   for h in ("path", "file", "dir", "dst", "target"))
+    return True  # attribute/subscript etc: assume a path, stay strict
+
+
 def _check_file(path: str) -> List[Tuple[int, str]]:
     src = open(path).read()
     try:
         tree = ast.parse(src)
     except SyntaxError as e:
         return [(e.lineno or 0, f"unparseable: {e.msg}")]
+    norm = os.path.normpath(path).lower()
     ckpt_file = "checkpoint" in os.path.basename(path).lower()
+    sharded_file = any(h in norm for h in SHARDED_PATH_HINTS)
     lines = src.splitlines()
     violations: List[Tuple[int, str]] = []
 
@@ -79,15 +143,14 @@ def _check_file(path: str) -> List[Tuple[int, str]]:
         visit_AsyncFunctionDef = visit_FunctionDef
 
         def visit_Call(self, node):
+            fn = self.stack[-1] if self.stack else None
+            name = fn.name if fn is not None else "<module>"
+            seg = ("\n".join(lines[fn.lineno - 1:fn.end_lineno])
+                   if fn is not None else src)
             if _is_write_call(node):
-                fn = self.stack[-1] if self.stack else None
-                name = fn.name if fn is not None else "<module>"
-                in_scope = ckpt_file or any(
+                in_scope = ckpt_file or sharded_file or any(
                     h in name.lower() for h in CKPT_NAME_HINTS)
                 if in_scope and name not in ALLOWED_FUNCS:
-                    seg = ("\n".join(
-                        lines[fn.lineno - 1:fn.end_lineno])
-                        if fn is not None else src)
                     safe = (all(m in seg for m in SAFE_MARKERS)
                             or any(h in seg for h in SAFE_CALL_HINTS))
                     if not safe:
@@ -96,6 +159,26 @@ def _check_file(path: str) -> List[Tuple[int, str]]:
                             f"{name}: non-atomic write on a checkpoint "
                             f"path (want .tmp + os.replace, or the "
                             f"Filesystem.write_bytes seam)"))
+            if sharded_file and (_is_seam_write(node) or (
+                    _is_write_call(node) and _writes_to_path(node))):
+                # sharded rule 1: every write — seam included — must show
+                # the .tmp staging discipline: either its path argument
+                # derives from the staging variable, or the enclosing
+                # function carries the staging markers
+                if not _path_arg_staged(node) and \
+                        not any(m in seg for m in STAGING_MARKERS):
+                    violations.append((
+                        node.lineno,
+                        f"{name}: sharded-checkpoint write outside .tmp "
+                        f"staging (every byte must stage under "
+                        f"<step>.tmp until the rank-0 replace)"))
+            if (sharded_file or ckpt_file) and _is_rename_call(node):
+                # sharded rule 2: the publish is ONE os.replace — rename/
+                # move have non-atomic or copy semantics across filesystems
+                violations.append((
+                    node.lineno,
+                    f"{name}: checkpoint publish must use os.replace "
+                    f"(os.rename/shutil.move are not the atomic commit)"))
             self.generic_visit(node)
 
     V().visit(tree)
