@@ -19,6 +19,12 @@ per-kernel path of the perf gate, docs/performance.md). With
 (default ``BENCH_BASELINE.json``) ready to commit and enforce with
 ``tools/check_regression.py CURRENT --suite BENCH_BASELINE.json`` —
 refreshing the committed gate is one command.
+
+``apex-tpu-bench --serve [--steps N]`` runs the serving micro-bench
+(apex_tpu.serve continuous batching on the tiny fp32 GPT-2): decode
+tokens/s, p50/p99 per-token latency, and TTFT as a ``serve_decode``
+BENCH_SUITE entry — same ``--emit-baseline`` + check_regression suite
+workflow as the kernel gate (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -171,11 +177,11 @@ def _telemetry_bench(jsonl_path: str, steps: int = 8,
         "goodput": summary["goodput"]["goodput_frac"]}))
 
 
-def _subset_bench(kernels: str | None, emit_baseline: str | None) -> None:
-    """Run a bench-suite subset directly (no worker/cache indirection) and
-    optionally write it as a committed-baseline artifact."""
+def _load_bench_module():
+    """Import the repo checkout's bench.py (the suite/baseline machinery
+    lives there, not in the wheel). Exits 2 with a clear message on a
+    wheel-only install — shared by the kernel-subset and serve modes."""
     import importlib.util
-    import json
 
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     bench_path = os.path.join(here, "bench.py")
@@ -188,6 +194,89 @@ def _subset_bench(kernels: str | None, emit_baseline: str | None) -> None:
                                                   bench_path)
     bench = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bench)
+    return bench
+
+
+def _serve_bench(steps: int, num_slots: int = 4,
+                 emit_baseline: "str | None" = None) -> None:
+    """Serving micro-bench: a scripted continuous-batching workload on the
+    tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
+    in the BENCH_SUITE entry shape, ready for the check_regression suite
+    gate (``tools/check_regression.py CURRENT --suite BASELINE --kernels
+    serve_decode``). Latency metrics are lower-is-better; the gate knows.
+    """
+    import dataclasses
+    import json
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.models.gpt2 import GPT2Config
+    from apex_tpu.serve.engine import Engine, EngineConfig, init_gpt2_params
+    from apex_tpu.serve.scheduler import Request, ServeScheduler
+
+    # resolve the baseline writer BEFORE benching: a wheel-only install
+    # must fail in milliseconds, not after the engine compiles and runs
+    bench = _load_bench_module() if emit_baseline else None
+
+    cfg = dataclasses.replace(GPT2Config.tiny(),
+                              compute_dtype=jnp.float32)
+    engine = Engine(cfg, init_gpt2_params(cfg),
+                    EngineConfig(num_slots=num_slots, max_len=64,
+                                 temperature=0.0), seed=0)
+    prompt_len = 8
+    engine.aot_compile([prompt_len])  # compiles land before the clock
+    rng = np.random.RandomState(0)
+    sched = ServeScheduler(engine)
+    # enough requests to keep every slot busy and exercise backfill
+    n_requests = max(2 * num_slots, (steps * num_slots) // 8 + 1)
+    for i in range(n_requests):
+        sched.submit(Request(
+            request_id=f"bench-{i}",
+            tokens=[int(t) for t in rng.randint(0, cfg.vocab_size,
+                                                prompt_len)],
+            max_new_tokens=8))
+    t0 = time.perf_counter()
+    stats = sched.run(max_steps=steps)
+    wall = time.perf_counter() - t0
+    s = stats.summary()
+    suite = {
+        "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "serve_decode": {
+            "metric": "serve_decode_tokens_per_s",
+            "value": s["tokens_per_s"], "unit": "tokens_per_s",
+            "p50_ms": s["p50_step_ms"], "p99_ms": s["p99_step_ms"],
+            "ttft_ms": s["ttft_p50_ms"],
+            "bench_wall_s": round(wall, 3),
+            # workload config nested as a dict: check_regression lifts
+            # only numeric scalars, so a capture with different
+            # --steps/--serve-slots than the baseline gates on PERF
+            # fields alone, not on its own configuration
+            "workload": {"steps": s["decode_steps"],
+                         "new_tokens": s["new_tokens"],
+                         "slots": num_slots},
+            # a subset capture, not the full committed suite
+            "complete": False,
+        },
+    }
+    if bench is not None:
+        # same contract as the kernel-subset gate: atomic publish via the
+        # repo bench module (loaded up front — a torn gate file is worse
+        # than no gate file)
+        bench.atomic_write_json(emit_baseline, suite)
+        print(json.dumps({"baseline": emit_baseline,
+                          "kernels": ["serve_decode"]}))
+    else:
+        print(json.dumps(suite, indent=1))
+
+
+def _subset_bench(kernels: str | None, emit_baseline: str | None) -> None:
+    """Run a bench-suite subset directly (no worker/cache indirection) and
+    optionally write it as a committed-baseline artifact."""
+    import json
+
+    bench = _load_bench_module()
 
     import jax
     import jax.numpy as jnp
@@ -234,17 +323,38 @@ def main() -> None:
         has_telemetry = any(a == "--telemetry-jsonl"
                             or a.startswith("--telemetry-jsonl=")
                             for a in sys.argv[1:])
-        has_subset = any(a.split("=", 1)[0] in ("--kernels",
-                                                "--emit-baseline")
-                         for a in sys.argv[1:])
-        if has_telemetry and has_subset:
+        has_serve = any(a == "--serve" for a in sys.argv[1:])
+        # --emit-baseline is shared by the serve and kernel-subset modes;
+        # --kernels is NOT valid with --serve and must keep refusing
+        has_subset = any(a.split("=", 1)[0] == "--kernels"
+                         for a in sys.argv[1:]) or (
+            any(a.split("=", 1)[0] == "--emit-baseline"
+                for a in sys.argv[1:]) and not has_serve)
+        if sum((has_telemetry, has_subset, has_serve)) > 1:
             # parse_known_args would silently swallow the other mode's
             # flags — refuse instead of pretending both ran
-            print("apex-tpu-bench: --telemetry-jsonl and "
+            print("apex-tpu-bench: --telemetry-jsonl, --serve, and "
                   "--kernels/--emit-baseline are separate modes; run "
-                  "them as two invocations", file=sys.stderr)
+                  "them as separate invocations", file=sys.stderr)
             sys.exit(2)
-        if has_telemetry:
+        if has_serve:
+            import argparse
+
+            ap = argparse.ArgumentParser(prog="apex-tpu-bench")
+            ap.add_argument("--serve", action="store_true")
+            ap.add_argument("--steps", type=int, default=16,
+                            help="decode steps to run (the workload "
+                                 "keeps slots busy with backfill)")
+            ap.add_argument("--serve-slots", type=int, default=4)
+            ap.add_argument("--emit-baseline", nargs="?",
+                            const="BENCH_BASELINE_SERVE.json",
+                            default=None,
+                            help="write the capture as a suite JSON "
+                                 "(default BENCH_BASELINE_SERVE.json)")
+            args, _ = ap.parse_known_args(sys.argv[1:])
+            _serve_bench(args.steps, args.serve_slots,
+                         args.emit_baseline)
+        elif has_telemetry:
             import argparse
 
             ap = argparse.ArgumentParser(prog="apex-tpu-bench")

@@ -88,15 +88,26 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens, return_hidden: bool = False):
+    def __call__(self, tokens, return_hidden: bool = False,
+                 position_offset=0):
+        """``position_offset`` shifts the learned positional embeddings:
+        token column ``j`` reads ``wpe[position_offset + j]`` — the same
+        offset contract as ``transformer.rope.fused_rope`` so a suffix of
+        a sequence (a serving decode window) sees the rotations/embeddings
+        of its absolute positions. Accepts a python int or a traced int32
+        scalar; caller guarantees ``position_offset + s <= n_positions``.
+        """
         c = self.cfg
         b, s = tokens.shape
         wte = self.param("wte", nn.initializers.normal(0.02),
                          (c.vocab_size, c.n_embd), jnp.float32)
         wpe = self.param("wpe", nn.initializers.normal(0.01),
                          (c.n_positions, c.n_embd), jnp.float32)
+        from apex_tpu.transformer.rope import _offset_slice
+
+        pos = _offset_slice(wpe, position_offset, s)
         x = wte[tokens].astype(c.compute_dtype) \
-            + wpe[:s][None].astype(c.compute_dtype)
+            + pos[None].astype(c.compute_dtype)
         for i in range(c.n_layer):
             x = Block(c, name=f"h_{i}")(x)
         x = FusedLayerNorm(c.n_embd, name="ln_f")(x)
@@ -116,3 +127,77 @@ def lm_loss(model: GPT2, params, tokens):
     logits = model.apply(params, tokens)
     loss = softmax_cross_entropy_loss(logits[:, :-1], tokens[:, 1:])
     return jnp.mean(loss)
+
+
+# --------------------------------------------------------------- serving
+#
+# The cache-aware forward used by apex_tpu.serve: ONE token per slot per
+# call, attention over the slot's cached K/V, learned positional
+# embeddings indexed by each slot's absolute position. It is a pure
+# function over the SAME param pytree GPT2.init/flax produce (no separate
+# serving weights), with every array shape fixed at [num_slots, ...] — the
+# serve engine's single-compile invariant rests on that. The flash kernel
+# is a training/prefill-batch device; at one query row per slot the MXU
+# work is a [1, L] matvec, so decode attention is the chunked-softmax XLA
+# path in serve.attention instead.
+
+
+def _affine_layer_norm(x, scale, bias, eps: float = 1e-5):
+    """Row LayerNorm for the decode path: the repo's jnp reference LN
+    (the same normalization FusedLayerNorm computes — at num_slots rows
+    there is no tile to amortize a Pallas launch over)."""
+    from apex_tpu.normalization.fused_layer_norm import manual_layer_norm
+
+    return manual_layer_norm(x, scale, bias, (x.shape[-1],), eps)
+
+
+def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
+                       write_mask, *, block_k=None):
+    """One decode token per slot through GPT-2 with the serving KV cache.
+
+    ``tokens``/``positions``/``write_mask``: ``[num_slots]`` (int32, int32,
+    bool). Each masked slot's token K/V is appended to the cache at
+    ``positions[slot]`` and the slot attends over cached positions
+    ``0..positions[slot]``; masked-off slots compute garbage that is
+    discarded and write nothing. Returns ``(logits [num_slots, vocab]
+    fp32, cache)``. ``block_k`` is the decode-attention KV chunk
+    (autotuned via ``apex_tpu.tune`` when None).
+    """
+    from apex_tpu.serve.attention import cached_attention
+    from apex_tpu.serve.kv_cache import write_token
+
+    c = cfg
+    dt = c.compute_dtype
+    h, d = c.n_head, c.n_embd // c.n_head
+    p = params["params"] if "params" in params else params
+    pos = positions.astype(jnp.int32)
+
+    x = (p["wte"][tokens].astype(dt)
+         + p["wpe"][jnp.clip(pos, 0, c.n_positions - 1)].astype(dt))
+    for i in range(c.n_layer):
+        blk = p[f"h_{i}"]
+        y = _affine_layer_norm(x, blk["ln_1"]["weight"],
+                               blk["ln_1"]["bias"])
+        qkv = (y.astype(dt) @ blk["attn_qkv"]["kernel"].astype(dt)
+               + blk["attn_qkv"]["bias"].astype(dt))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(-1, h, d)
+        k = k.reshape(-1, h, d)
+        v = v.reshape(-1, h, d)
+        cache = write_token(cache, i, k, v, pos, write_mask)
+        o = cached_attention(q, cache.k[i], cache.v[i], pos,
+                             block_k=block_k)
+        o = o.reshape(-1, c.n_embd)
+        x = x + (o.astype(dt) @ blk["attn_out"]["kernel"].astype(dt)
+                 + blk["attn_out"]["bias"].astype(dt))
+        y = _affine_layer_norm(x, blk["ln_2"]["weight"],
+                               blk["ln_2"]["bias"])
+        x = x + dense_gelu_dense(y, blk["mlp_fc_w"].astype(dt),
+                                 blk["mlp_fc_b"].astype(dt),
+                                 blk["mlp_proj_w"].astype(dt),
+                                 blk["mlp_proj_b"].astype(dt))
+    x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
+    logits = jax.lax.dot_general(
+        x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return logits, cache

@@ -41,14 +41,23 @@ STALL_EVENTS = {
     # actual stall duration of the stuck collective
     "collective_stall": "collective_stall",
     "collective_stall_cleared": "collective_stall",
+    # serving: time a request sat in the admission queue because no cache
+    # slot was free — capacity lost to queueing, not to compute
+    "serve_queue_wait": "serve_queue_wait",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
+# and lifecycle signals from the serving scheduler (every serve_* event
+# the serve package publishes must appear here or in STALL_EVENTS —
+# tests/test_monitor.py greps the sources and fails on an unregistered
+# serving event)
 COUNTED_EVENTS = (
     "overflow_step_skipped", "overflow_storm", "overflow_storm_cleared",
     "checkpoint_save_retry", "checkpoint_skipped_corrupt",
     "checkpoint_quarantined", "collective_stall_abort",
     "preemption_requested", "bench_preempted",
+    "serve_request_admitted", "serve_request_completed",
+    "serve_request_evicted", "serve_decode_step",
 )
 
 _OVERFLOW_CAUSE = "overflow_skip"

@@ -106,3 +106,19 @@ def groupnorm_hw_block(hw: int, c: int) -> int:
     budget = max(vmem_row_budget(c * 4), SUBLANE)
     blk = min(pow2_floor(budget), hw)
     return fit_block_rows(hw, blk, start=blk)
+
+
+def decode_attention_block(max_len: int) -> int:
+    """Serving decode-attention KV tile (apex_tpu.serve.attention): how
+    many cached key/value rows each partial-softmax chunk covers. Wants to
+    be large (fewer partial reductions) but bounded so a chunk of K plus V
+    stays comfortably VMEM-resident next to the weights; must divide the
+    static ``max_len``. The largest divisor of ``max_len`` that is
+    <= 512 — i.e. 512 for the usual pow2 cache lengths; lengths with no
+    such divisor above 1 (primes and odd lengths) get ONE chunk of
+    ``max_len`` rather than a degenerate per-row unroll."""
+    max_len = max(int(max_len), 1)
+    for blk in range(min(max_len, 512), 1, -1):
+        if max_len % blk == 0:
+            return blk
+    return max_len

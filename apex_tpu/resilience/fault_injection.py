@@ -20,6 +20,10 @@ Production failure modes, reproduced on a laptop with a seed:
   barrier arrival (what a hung host looks like to the collective
   watchdog), and ``lose_shard``/``duplicate_shard`` corrupt a *committed*
   sharded checkpoint in place.
+- **Serving aborts** — ``abort_request(request_id, at_step)`` schedules a
+  mid-stream request cancellation that the serve scheduler
+  (:class:`~apex_tpu.serve.scheduler.ServeScheduler`) consumes before the
+  given decode step — a client disconnect at a replayable point.
 - **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
   window of steps whose gradients ``poison_grads`` fills with NaN/Inf
   (choice seeded), reproducing the overflow storms that collapse a dynamic
@@ -111,6 +115,7 @@ class FaultInjector:
         self._drop_write_patterns: List[re.Pattern] = []
         self._crash_replace_patterns: List[re.Pattern] = []
         self._stragglers: List[List[Any]] = []  # [rank, name|None, delay_s]
+        self._serve_aborts: Dict[int, List[Any]] = {}  # step -> request ids
 
     # ---- filesystem faults ---------------------------------------------
     def filesystem(self) -> Filesystem:
@@ -219,6 +224,24 @@ class FaultInjector:
         src, dst = files[i], files[i + 1]
         shutil.copyfile(src, dst)
         return src, dst
+
+    # ---- serving: scripted mid-stream aborts ----------------------------
+    def abort_request(self, request_id: Any, at_step: int
+                      ) -> "FaultInjector":
+        """Schedule a serving-request abort: the
+        :class:`~apex_tpu.serve.scheduler.ServeScheduler` polls
+        :meth:`serve_aborts_due` before decode step ``at_step`` and
+        aborts the request — a client disconnect / cancellation at an
+        exact, replayable point in the decode stream. Tier-1 uses this to
+        prove the other slots' outputs are bit-identical with and without
+        the abort."""
+        self._serve_aborts.setdefault(int(at_step), []).append(request_id)
+        return self
+
+    def serve_aborts_due(self, step: int) -> List[Any]:
+        """Request ids scheduled to abort before decode step ``step``
+        (consumed: each schedule fires once)."""
+        return self._serve_aborts.pop(int(step), [])
 
     # ---- preemption -----------------------------------------------------
     def fire_preemption(self, sig: int = signal.SIGTERM) -> None:

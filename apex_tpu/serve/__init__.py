@@ -1,0 +1,41 @@
+"""TPU-native inference engine — static-shape KV cache, one-jit decode,
+continuous batching.
+
+Serving throughput on TPU is won by keeping the compiled graph stable
+(TokenWeave, arXiv:2505.11329; operation-fusion serving, arXiv:2502.17728):
+XLA rewards a single jitted decode step over fixed-shape buffers, and
+punishes anything that changes shapes mid-stream with a recompile that
+costs more than the tokens it produces. This package is built around that
+one invariant:
+
+- :mod:`~apex_tpu.serve.kv_cache` — a slot-addressed, static-shape KV
+  cache pytree (``[n_layer, num_slots, max_len, heads, head_dim]`` plus a
+  per-slot length vector). ``insert``/``append``/``evict`` are pure,
+  jittable, mask-driven ops: batch membership changes (a request finishes,
+  another backfills its slot) never change a shape and therefore never
+  trigger a recompile.
+- :mod:`~apex_tpu.serve.engine` — AOT-lowered ``prefill`` and the ONE
+  jitted ``decode_step``: every token in the system, prefill or decode,
+  flows through the same ``[num_slots, 1]`` forward, so incremental decode
+  is bit-identical to prefill in fp32 and slots are arithmetically
+  isolated from each other.
+- :mod:`~apex_tpu.serve.scheduler` — continuous batching: an admission
+  queue, slot assignment, per-request EOS/max-token termination, eviction
+  and backfill between decode steps, with TTFT/latency/throughput
+  accounting and ``serve_*`` events on the telemetry bus.
+- :mod:`~apex_tpu.serve.cli` — ``apex-tpu-serve``: load a model config,
+  run a scripted or stdin request stream, print per-request stats.
+
+See docs/serving.md for the architecture and the slot lifecycle.
+"""
+
+from apex_tpu.serve.engine import Engine, EngineConfig  # noqa: F401
+from apex_tpu.serve.kv_cache import (KVCache, evict_slots,  # noqa: F401
+                                     init_cache, write_token)
+from apex_tpu.serve.scheduler import (Request, ServeScheduler,  # noqa: F401
+                                      ServeStats)
+
+__all__ = [
+    "Engine", "EngineConfig", "KVCache", "init_cache", "write_token",
+    "evict_slots", "Request", "ServeScheduler", "ServeStats",
+]

@@ -1,0 +1,115 @@
+"""Decode attention over the static KV cache.
+
+One query token per slot against that slot's cached keys/values. The key
+axis is the cache's static ``max_len``; reachability is a mask
+(``key_pos <= position``), never a shape — so the op compiles once and a
+slot's result depends only on that slot's bytes (reductions run within a
+slot; other slots' values cannot perturb the arithmetic, which is what
+makes mid-stream eviction bit-invisible to its neighbors).
+
+The softmax is computed in explicitly chunked form over the key axis:
+``block_k`` cached rows per partial reduction, partials combined in a
+static python loop. The chunk geometry is what :mod:`apex_tpu.tune` tunes
+(kernel name ``decode_attention``): on TPU the XLA fusion streams one
+``[block_k, head_dim]`` K/V tile at a time through VMEM, so the block size
+is a real tile-geometry knob, with
+:func:`~apex_tpu.ops.pallas.tiling.decode_attention_block` as the
+committed heuristic. Both the prefill scan body and the decode step call
+this function with the same geometry, so the two paths stay bit-identical.
+
+All math fp32 (max-subtracted softmax; the row's own token is always
+reachable, so the denominator is never empty); IO dtype preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.pallas.tiling import decode_attention_block
+from apex_tpu.tune.api import tuned_params
+
+_f32 = jnp.float32
+NEG_INF = jnp.float32(-1e30)
+
+
+def resolve_block_k(max_len: int, heads: int, head_dim: int, dtype,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> int:
+    """The decode KV-chunk size: explicit value (validated), else the
+    autotuned winner for this (max_len, heads, head_dim, dtype, chip),
+    else the committed heuristic."""
+    if block_k is not None:
+        bk = int(block_k)
+        if bk <= 0 or max_len % bk:
+            raise ValueError(
+                f"block_k={bk} must be positive and divide the cache "
+                f"max_len={max_len} (the chunked softmax tiles the static "
+                f"key axis exactly)")
+        return bk
+    # max_len is keyed EXACTLY (not pow2-bucketed): it is a static,
+    # layout-defining engine constant and the winner must divide it — a
+    # bucketed key would warm entries that can never validate for
+    # non-pow2 cache lengths
+    p = tuned_params(
+        "decode_attention",
+        (("max_len", int(max_len)), ("heads", heads), ("d", head_dim)),
+        {"block_k": decode_attention_block(max_len)},
+        dtype=dtype, interpret=interpret,
+        validate=lambda pr: (pr["block_k"] > 0
+                             and max_len % pr["block_k"] == 0))
+    return int(p["block_k"])
+
+
+def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
+                     positions: jax.Array, *,
+                     scale: Optional[float] = None,
+                     block_k: Optional[int] = None,
+                     interpret: Optional[bool] = None) -> jax.Array:
+    """Single-token attention over cached K/V.
+
+    ``q``: ``[num_slots, heads, head_dim]`` (this step's query per slot);
+    ``k_cache``/``v_cache``: ``[num_slots, max_len, heads, head_dim]``;
+    ``positions``: ``[num_slots]`` int32 — slot ``b`` attends to cached
+    positions ``0 .. positions[b]`` inclusive (its own just-appended token
+    is position ``positions[b]``). Returns ``[num_slots, heads, head_dim]``
+    in ``q.dtype``.
+    """
+    b, L, h, d = k_cache.shape
+    bk = resolve_block_k(L, h, d, q.dtype, block_k, interpret)
+    s = jnp.float32(scale if scale is not None else 1.0 / (d ** 0.5))
+
+    # fully chunked over the key axis: scores, masking, exp, and the
+    # V-side accumulation all touch one [block_k] tile of K and V per
+    # step, so block_k genuinely bounds the streamed working set (the
+    # premise the decode_attention autotuner times). Chunking changes no
+    # value: each score's reduction runs over d (not L), and the global
+    # row max equals the max over chunk maxima bit-for-bit — only the
+    # SUM order depends on block_k, identically in prefill and decode.
+    q32 = q.astype(_f32)
+    pos = positions.astype(jnp.int32)[:, None, None]
+    nchunk = L // bk
+
+    def chunk_scores(i):
+        ks = k_cache[:, i * bk:(i + 1) * bk].astype(_f32)
+        sc = jnp.einsum("bhd,bkhd->bhk", q32, ks) * s     # [b, h, bk]
+        kpos = jnp.arange(i * bk, (i + 1) * bk, dtype=jnp.int32)
+        reach = kpos[None, None, :] <= pos
+        return jnp.where(reach, sc, NEG_INF), reach
+
+    chunks = [chunk_scores(i) for i in range(nchunk)]     # static unroll
+    m = chunks[0][0].max(axis=-1, keepdims=True)
+    for sc, _ in chunks[1:]:
+        m = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+
+    num = jnp.zeros((b, h, d), _f32)
+    den = jnp.zeros((b, h), _f32)
+    for i, (sc, reach) in enumerate(chunks):
+        e = jnp.where(reach, jnp.exp(sc - m), 0.0)        # [b, h, bk]
+        den = den + jnp.sum(e, axis=-1)
+        num = num + jnp.einsum(
+            "bhk,bkhd->bhd", e, v_cache[:, i * bk:(i + 1) * bk]
+            .astype(_f32))
+    return (num / den[..., None]).astype(q.dtype)

@@ -1,0 +1,154 @@
+"""Console entry point (``apex-tpu-serve``) — run a request stream
+through the serving engine and print per-request stats.
+
+Two request sources:
+
+- scripted (default): ``--requests N`` seeded random prompts — the
+  repeatable smoke/bench workload;
+- ``--stdin``: one request per line, whitespace- or comma-separated token
+  ids (the engine speaks token ids; tokenization lives with the caller).
+
+Per request, one JSON line: ``{request_id, state, finish_reason,
+prompt_tokens, new_tokens, generated, ttft_s, latency_s, tokens_per_s}``;
+the final line is the aggregate summary (tokens/s, p50/p99 per-step
+latency, TTFT). ``serve_*`` lifecycle events ride the telemetry bus —
+``--telemetry-jsonl PATH`` mirrors them (and nothing else crosses the
+host boundary per step beyond the sampled tokens).
+
+Example::
+
+    apex-tpu-serve --config tiny --requests 4 --max-new-tokens 8 \
+        --temperature 0 --seed 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def _parse_line(line: str) -> List[int]:
+    toks = line.replace(",", " ").split()
+    return [int(t) for t in toks]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="apex-tpu-serve",
+        description="run a scripted or stdin token-id request stream "
+                    "through the apex_tpu.serve engine")
+    ap.add_argument("--config", default="tiny",
+                    choices=["tiny", "small", "xl"],
+                    help="GPT2Config preset (default tiny)")
+    ap.add_argument("--dtype", default="fp32",
+                    choices=["fp32", "bf16"],
+                    help="compute dtype (fp32 default: bit-exact decode)")
+    ap.add_argument("--num-slots", type=int, default=4)
+    ap.add_argument("--max-len", type=int, default=64,
+                    help="per-slot context bound (prompt + generated)")
+    ap.add_argument("--max-new-tokens", type=int, default=16)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--eos-id", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--requests", type=int, default=4,
+                    help="scripted request count (ignored with --stdin)")
+    ap.add_argument("--prompt-len", type=int, default=8,
+                    help="scripted prompt length")
+    ap.add_argument("--stdin", action="store_true",
+                    help="read one token-id request per input line")
+    ap.add_argument("--aot", action="store_true",
+                    help="AOT-compile decode + the prompt bucket before "
+                         "serving (startup pays the trace, not traffic)")
+    ap.add_argument("--telemetry-jsonl", default=None,
+                    help="mirror serve_* bus events into this JSONL")
+    args = ap.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    from apex_tpu.models.gpt2 import GPT2Config
+    from apex_tpu.serve.engine import (Engine, EngineConfig,
+                                       init_gpt2_params)
+    from apex_tpu.serve.scheduler import Request, ServeScheduler
+
+    cfg = getattr(GPT2Config, args.config)()
+    if args.dtype == "fp32":
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32)
+    max_len = min(args.max_len, cfg.n_positions)
+    if max_len < args.max_len:
+        print(f"apex-tpu-serve: --max-len {args.max_len} clamped to the "
+              f"model's n_positions={max_len}", file=sys.stderr)
+
+    # validate the request stream BEFORE paying for params + compiles: a
+    # malformed stdin line must fail in milliseconds, not after trace time
+    if args.stdin:
+        try:
+            prompts = [p for p in (_parse_line(l) for l in sys.stdin)
+                       if p]
+        except ValueError as e:
+            print(f"apex-tpu-serve: request lines must be whitespace- or "
+                  f"comma-separated integer token ids ({e})",
+                  file=sys.stderr)
+            return 2
+    else:
+        rng = np.random.RandomState(args.seed)
+        plen = max(1, min(args.prompt_len, max_len - 1))
+        prompts = [[int(t) for t in rng.randint(0, cfg.vocab_size, plen)]
+                   for _ in range(args.requests)]
+    if not prompts:
+        print("apex-tpu-serve: no requests", file=sys.stderr)
+        return 2
+    bad = [i for i, p in enumerate(prompts)
+           if max(p) >= cfg.vocab_size or min(p) < 0]
+    if bad:
+        print(f"apex-tpu-serve: request {bad[0]} has token ids outside "
+              f"vocab [0, {cfg.vocab_size})", file=sys.stderr)
+        return 2
+    long = [i for i, p in enumerate(prompts) if len(p) >= max_len]
+    if long:
+        print(f"apex-tpu-serve: request {long[0]} has "
+              f"{len(prompts[long[0]])} tokens — no room to generate "
+              f"under max_len={max_len}", file=sys.stderr)
+        return 2
+
+    engine = Engine(
+        cfg, init_gpt2_params(cfg, seed=args.seed),
+        EngineConfig(num_slots=args.num_slots, max_len=max_len,
+                     temperature=args.temperature, top_k=args.top_k),
+        seed=args.seed)
+
+    if args.aot:
+        engine.aot_compile([max(len(p) for p in prompts)])
+
+    tel = None
+    if args.telemetry_jsonl:
+        from apex_tpu.monitor import Telemetry
+
+        tel = Telemetry(args.telemetry_jsonl)
+
+    sched = ServeScheduler(engine)
+    for i, toks in enumerate(prompts):
+        sched.submit(Request(request_id=f"req-{i}", tokens=toks,
+                             max_new_tokens=args.max_new_tokens,
+                             eos_id=args.eos_id))
+    stats = sched.run()
+    if tel is not None:
+        tel.close()
+
+    for rec in stats.requests:
+        print(json.dumps(rec, sort_keys=True))
+    print(json.dumps({"summary": stats.summary(),
+                      "decode_compiles": engine.decode_traces,
+                      "prefill_compiles": engine.prefill_traces},
+                     sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,296 @@
+"""AOT prefill + one-jit decode over the static KV cache.
+
+The engine owns three compiled artifacts and NOTHING else touches the
+device:
+
+- ``decode_step`` — ONE jitted function, ``[num_slots]`` tokens in,
+  ``[num_slots]`` sampled tokens out. Admission, completion, eviction, and
+  backfill all happen by changing *values* (masks, lengths), so the jit
+  cache holds exactly one entry for the life of the engine — asserted by
+  tier-1 (``Engine.decode_traces``).
+- ``prefill`` — a ``lax.scan`` of the *same* single-token forward over the
+  prompt positions, at the same ``[num_slots]`` width (non-admitted slots
+  mask their writes). One compile per pow2 prompt-length bucket. Because
+  prefill and decode share the forward at identical shapes, an
+  incrementally decoded token's logits are bit-identical (fp32) to the
+  same token's logits under full-sequence prefill — there is no
+  "prefill path" to drift from.
+- ``evict`` — a mask-shaped length reset (kv_cache.evict_slots), one
+  compile total.
+
+Sampling (temperature / top-k, greedy at ``temperature=0``) runs inside
+the jitted step under a threaded PRNG key: the key is part of engine
+state, split in-graph, and returned — a fixed seed replays a stream
+bit-for-bit.
+
+``aot_compile()`` lowers and compiles decode (and any requested prompt
+buckets) ahead of time — the serving analog of the repo's AOT tooling: no
+request ever pays a trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.models.gpt2 import GPT2Config, gpt2_token_forward
+from apex_tpu.ops.pallas.tiling import pow2_ceil
+from apex_tpu.serve import kv_cache
+from apex_tpu.serve.attention import resolve_block_k
+from apex_tpu.serve.kv_cache import KVCache, init_cache
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Serving-side knobs (the model config stays ``GPT2Config``)."""
+
+    num_slots: int = 4
+    max_len: Optional[int] = None      # default: model n_positions
+    temperature: float = 1.0           # 0 => greedy argmax
+    top_k: int = 0                     # 0 => full vocab
+    block_k: Optional[int] = None      # decode-attention KV chunk (tuned)
+    # keep per-position prefill logits (parity tests / scoring). O(P*B*V)
+    # memory — leave False for real vocabularies.
+    keep_prefill_logits: bool = False
+
+
+class Engine:
+    """A servable GPT-2: static cache + compiled prefill/decode.
+
+    ``params`` is the standard flax param pytree of ``models.gpt2.GPT2``
+    (``model.init(...)`` or a training checkpoint); serving casts to the
+    model config's ``compute_dtype`` on the fly. Use fp32 configs for
+    bit-exactness claims.
+    """
+
+    def __init__(self, model_cfg: GPT2Config, params,
+                 config: EngineConfig = EngineConfig(), *, seed: int = 0):
+        self.model_cfg = model_cfg
+        self.config = config
+        self.params = params
+        self.max_len = int(config.max_len or model_cfg.n_positions)
+        if self.max_len > model_cfg.n_positions:
+            raise ValueError(
+                f"max_len={self.max_len} exceeds the model's "
+                f"n_positions={model_cfg.n_positions}")
+        h, d = model_cfg.n_head, model_cfg.n_embd // model_cfg.n_head
+        # resolve the tuned geometry ONCE at engine build (cache lookups
+        # at trace time inside scan would re-announce per position)
+        self.block_k = resolve_block_k(self.max_len, h, d,
+                                       model_cfg.compute_dtype,
+                                       config.block_k)
+        self._init_state(seed)
+
+        # trace counters: tier-1 asserts decode_traces == 1 across a full
+        # admit/complete/evict/backfill trace (the one-jit invariant)
+        self.decode_traces = 0
+        self.prefill_traces = 0
+
+        self._decode = jax.jit(self._decode_fn)
+        self._decode_aot = None
+        self._prefill_jits: Dict[int, Any] = {}
+        self._prefill_aot: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------ graphs
+    def _sample(self, logits, rng):
+        """Temperature / top-k sampling; greedy when temperature == 0."""
+        t = float(self.config.temperature)
+        k = int(self.config.top_k)
+        if t <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / jnp.float32(t)
+        if k > 0 and k < logits.shape[-1]:
+            kth = jax.lax.top_k(scaled, k)[0][..., -1:]
+            scaled = jnp.where(scaled < kth, jnp.float32(-1e30), scaled)
+        return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+    def _token_step(self, cache, tokens, positions, mask):
+        return gpt2_token_forward(self.model_cfg, self.params, cache,
+                                  tokens, positions, mask,
+                                  block_k=self.block_k)
+
+    def _decode_fn(self, cache, last_tokens, active, rng):
+        self.decode_traces += 1          # python side effect: trace count
+        positions = cache.lengths
+        logits, cache = self._token_step(cache, last_tokens, positions,
+                                         active)
+        rng, sub = jax.random.split(rng)
+        next_tokens = self._sample(logits, sub)
+        cache = kv_cache.advance(cache, active)
+        return next_tokens, logits, cache, rng
+
+    def _make_prefill(self, bucket: int):
+        keep = self.config.keep_prefill_logits
+
+        def prefill_fn(cache, tokens, admit, prompt_lens, rng):
+            self.prefill_traces += 1
+            cache = kv_cache.reset_slots(cache, admit)
+
+            def body(carry, p):
+                cache, last_logits = carry
+                write = admit & (p < prompt_lens)
+                positions = jnp.where(write, p, cache.lengths)
+                logits, cache = self._token_step(
+                    cache, tokens[:, p], positions, write)
+                last_logits = jnp.where(write[:, None], logits,
+                                        last_logits)
+                return (cache, last_logits), (logits if keep else None)
+
+            vocab = self.model_cfg.vocab_size
+            init_logits = jnp.zeros((self.config.num_slots, vocab),
+                                    jnp.float32)
+            (cache, last_logits), all_logits = jax.lax.scan(
+                body, (cache, init_logits),
+                jnp.arange(bucket, dtype=jnp.int32))
+            cache = kv_cache.set_lengths(cache, admit, prompt_lens)
+            rng, sub = jax.random.split(rng)
+            first_tokens = self._sample(last_logits, sub)
+            return cache, first_tokens, last_logits, all_logits, rng
+
+        return jax.jit(prefill_fn)
+
+    # -------------------------------------------------------------- AOT
+    def _decode_args(self):
+        return (self.cache, jnp.zeros((self.config.num_slots,), jnp.int32),
+                jnp.zeros((self.config.num_slots,), bool), self.rng)
+
+    def _prefill_args(self, bucket: int):
+        b = self.config.num_slots
+        return (self.cache, jnp.zeros((b, bucket), jnp.int32),
+                jnp.zeros((b,), bool), jnp.zeros((b,), jnp.int32),
+                self.rng)
+
+    def aot_compile(self, prompt_buckets: Sequence[int] = ()) -> "Engine":
+        """Lower + compile decode (and the given prompt-length buckets)
+        ahead of the first request — startup pays the trace, not traffic.
+        """
+        if self._decode_aot is None:
+            self._decode_aot = self._decode.lower(
+                *self._decode_args()).compile()
+        for bucket in prompt_buckets:
+            bucket = pow2_ceil(int(bucket))
+            if bucket not in self._prefill_aot:
+                fn = self._prefill_jits.setdefault(
+                    bucket, self._make_prefill(bucket))
+                self._prefill_aot[bucket] = fn.lower(
+                    *self._prefill_args(bucket)).compile()
+        return self
+
+    def _init_state(self, seed: int) -> None:
+        """ALL mutable serving state lives here (shared by __init__ and
+        :meth:`reset` so a drain/restart can never miss a field)."""
+        h = self.model_cfg.n_head
+        d = self.model_cfg.n_embd // h
+        self.cache: KVCache = init_cache(
+            self.model_cfg.n_layer, self.config.num_slots, self.max_len,
+            h, d, self.model_cfg.compute_dtype)
+        self.rng = jax.random.PRNGKey(seed)
+        self.last_tokens = np.zeros((self.config.num_slots,), np.int32)
+        # host mirror of cache.lengths (advanced deterministically by
+        # prefill/decode/evict) — lets decode_step enforce the context
+        # bound without a per-step device fetch
+        self._host_lengths = np.zeros((self.config.num_slots,), np.int64)
+
+    def reset(self, seed: int = 0) -> "Engine":
+        """Drop all serving state — empty cache, fresh PRNG stream — while
+        keeping every compiled artifact (the jits close over params only).
+        A server drain/restart costs zero recompiles; tests reuse one
+        compiled engine across scenarios."""
+        self._init_state(seed)
+        return self
+
+    # ------------------------------------------------------------- calls
+    def prefill(self, prompts: Dict[int, Sequence[int]]):
+        """Insert ``{slot: prompt token ids}`` in one compiled call.
+
+        Pads every prompt to the shared pow2 bucket, resets the target
+        slots, scans the single-token forward over the prompt positions
+        (non-target slots are fully masked), and samples each admitted
+        slot's first generated token. Returns ``(first_tokens [B],
+        last_logits [B, vocab], all_logits [P, B, vocab] | None)``; only
+        the admitted slots' rows are meaningful.
+        """
+        if not prompts:
+            raise ValueError("prefill needs at least one slot: prompt")
+        b = self.config.num_slots
+        max_p = max(len(t) for t in prompts.values())
+        if max_p < 1:
+            raise ValueError("empty prompt")
+        for slot, toks in prompts.items():
+            if not 0 <= slot < b:
+                raise ValueError(f"slot {slot} out of range 0..{b - 1}")
+            if len(toks) > self.max_len:
+                raise ValueError(
+                    f"prompt of {len(toks)} tokens exceeds max_len="
+                    f"{self.max_len}")
+        bucket = pow2_ceil(max_p)
+        tokens = np.zeros((b, bucket), np.int32)
+        admit = np.zeros((b,), bool)
+        lens = np.zeros((b,), np.int32)
+        for slot, toks in prompts.items():
+            tokens[slot, :len(toks)] = np.asarray(toks, np.int32)
+            admit[slot] = True
+            lens[slot] = len(toks)
+
+        fn = self._prefill_aot.get(bucket)
+        if fn is None:
+            fn = self._prefill_jits.setdefault(
+                bucket, self._make_prefill(bucket))
+        self.cache, first, last_logits, all_logits, self.rng = fn(
+            self.cache, jnp.asarray(tokens), jnp.asarray(admit),
+            jnp.asarray(lens), self.rng)
+        first_np = np.asarray(first)
+        self.last_tokens = np.where(admit, first_np, self.last_tokens)
+        self._host_lengths = np.where(admit, lens, self._host_lengths)
+        return first_np, last_logits, all_logits
+
+    def decode_step(self, last_tokens, active):
+        """One decode step for every slot: feed each active slot its last
+        token, get its next. ``last_tokens`` ``[num_slots]`` int,
+        ``active`` ``[num_slots]`` bool. Returns ``(next_tokens
+        np.ndarray, logits [num_slots, vocab] device array)``."""
+        act_np = np.asarray(active, bool)
+        full = act_np & (self._host_lengths >= self.max_len)
+        if full.any():
+            # the cache write would silently clip to max_len - 1 and
+            # corrupt the newest K/V row — refuse instead; the scheduler
+            # terminates at context-full before ever reaching this
+            raise ValueError(
+                f"slot(s) {np.flatnonzero(full).tolist()} are at "
+                f"max_len={self.max_len}; evict or raise max_len before "
+                f"decoding further")
+        fn = self._decode_aot or self._decode
+        lt = jnp.asarray(np.asarray(last_tokens, np.int32))
+        act = jnp.asarray(act_np)
+        next_tokens, logits, self.cache, self.rng = fn(
+            self.cache, lt, act, self.rng)
+        next_np = np.asarray(next_tokens)
+        self.last_tokens = np.where(act_np, next_np, self.last_tokens)
+        self._host_lengths = self._host_lengths + act_np
+        return next_np, logits
+
+    def evict(self, slots) -> None:
+        """Free the given slot indices (mask-shaped op, compiled once)."""
+        mask = np.zeros((self.config.num_slots,), bool)
+        mask[np.asarray(list(slots), np.int64)] = True
+        self.cache = kv_cache.evict_slots(self.cache, jnp.asarray(mask))
+        self._host_lengths = np.where(mask, 0, self._host_lengths)
+
+    @property
+    def lengths(self) -> np.ndarray:
+        return np.asarray(self.cache.lengths)
+
+
+def init_gpt2_params(cfg: GPT2Config, seed: int = 0):
+    """Random GPT-2 params for smoke/bench serving (real deployments load
+    a checkpoint). Init runs the training forward once at a short length.
+    """
+    from apex_tpu.models.gpt2 import GPT2
+
+    model = GPT2(cfg)
+    dummy = jnp.zeros((1, min(8, cfg.n_positions)), jnp.int32)
+    return model.init(jax.random.PRNGKey(seed), dummy)

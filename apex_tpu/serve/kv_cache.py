@@ -1,0 +1,115 @@
+"""Static-shape, slot-addressed KV cache.
+
+One buffer pair per layer, all layers stacked on a leading axis:
+``k``/``v`` are ``[n_layer, num_slots, max_len, heads, head_dim]`` and
+``lengths`` is ``[num_slots]`` — the number of tokens resident per slot.
+The arrays never change shape for the lifetime of the engine; request
+admission, completion, and eviction only move *values* (a length reset, a
+masked token write), so the jitted decode step that closes over this
+pytree compiles exactly once.
+
+All mutators are pure functions returning a new :class:`KVCache` (the
+engine's jitted callables donate nothing and alias nothing). Masked writes
+read-modify-write the existing token so an inactive slot's bytes are
+untouched — slot isolation is structural, not best-effort.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class KVCache:
+    """Pytree of the serving cache; see module docstring for shapes."""
+
+    k: jax.Array        # [n_layer, num_slots, max_len, heads, head_dim]
+    v: jax.Array        # same shape as k
+    lengths: jax.Array  # [num_slots] int32 — tokens resident per slot
+
+    @property
+    def n_layer(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(n_layer: int, num_slots: int, max_len: int, heads: int,
+               head_dim: int, dtype: Any = jnp.float32) -> KVCache:
+    """Allocate an empty cache. ``max_len`` bounds every request's total
+    context (prompt + generated); the scheduler terminates a request that
+    reaches it."""
+    shape = (n_layer, num_slots, max_len, heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((num_slots,), jnp.int32))
+
+
+def write_token(cache: KVCache, layer: int, k_tok: jax.Array,
+                v_tok: jax.Array, positions: jax.Array,
+                mask: jax.Array) -> KVCache:
+    """Write one token's K/V per slot at ``positions[slot]`` where
+    ``mask[slot]`` — the append primitive of both prefill and decode.
+
+    ``k_tok``/``v_tok``: ``[num_slots, heads, head_dim]``; ``positions``:
+    ``[num_slots]`` int32; ``mask``: ``[num_slots]`` bool. ``layer`` is a
+    python int (the model unrolls its layers), so the layer slice is
+    static. Masked-off slots get their current token written back
+    bit-for-bit; shapes never change, so this is recompile-free under jit.
+    """
+    def _one(buf, tok, pos):       # buf [L, h, d], tok [h, d]
+        return jax.lax.dynamic_update_slice(buf, tok[None], (pos, 0, 0))
+
+    def _read(buf, pos):
+        return jax.lax.dynamic_slice(
+            buf, (pos, 0, 0), (1,) + buf.shape[1:])[0]
+
+    pos = jnp.clip(positions.astype(jnp.int32), 0, cache.max_len - 1)
+    out = {}
+    for name, tok in (("k", k_tok), ("v", v_tok)):
+        buf = getattr(cache, name)[layer]              # [B, L, h, d]
+        cur = jax.vmap(_read)(buf, pos)                # [B, h, d]
+        new = jnp.where(mask[:, None, None], tok.astype(buf.dtype), cur)
+        out[name] = getattr(cache, name).at[layer].set(
+            jax.vmap(_one)(buf, new, pos))
+    return cache.replace(k=out["k"], v=out["v"])
+
+
+def advance(cache: KVCache, mask: jax.Array) -> KVCache:
+    """Bump ``lengths`` by one for masked slots (after a decode append)."""
+    return cache.replace(
+        lengths=cache.lengths + mask.astype(jnp.int32))
+
+
+def reset_slots(cache: KVCache, mask: jax.Array) -> KVCache:
+    """Zero masked slots' lengths — insertion prologue: the slot's stale
+    bytes stay in place and are unreachable behind ``lengths``."""
+    return cache.replace(
+        lengths=jnp.where(mask, 0, cache.lengths).astype(jnp.int32))
+
+
+def set_lengths(cache: KVCache, mask: jax.Array,
+                new_lengths: jax.Array) -> KVCache:
+    """Set masked slots' lengths (prefill epilogue: prompt lengths)."""
+    return cache.replace(
+        lengths=jnp.where(mask, new_lengths,
+                          cache.lengths).astype(jnp.int32))
+
+
+# host-callable eviction: ONE jitted (mask-shaped) op, compiled once per
+# engine — freeing a slot between decode steps cannot recompile anything
+@jax.jit
+def evict_slots(cache: KVCache, mask: jax.Array) -> KVCache:
+    """Free masked slots. Data is left in place; only ``lengths`` moves —
+    the attention mask (``key_pos <= position``) makes the stale rows
+    unreachable, and the next insert overwrites them."""
+    return reset_slots(cache, mask)

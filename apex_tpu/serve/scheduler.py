@@ -1,0 +1,322 @@
+"""Continuous-batching request scheduler.
+
+The serving loop between decode steps, in pure host python (everything
+device-side is the engine's fixed-shape compiled calls):
+
+admission queue -> slot assignment (batched prefill) -> decode -> per-slot
+termination (EOS / max new tokens / context full) -> eviction -> backfill
+from the queue -> next decode step.
+
+Lifecycle events ride the PR-2 telemetry bus
+(:func:`apex_tpu.utils.logging.publish_event`) so a
+:class:`~apex_tpu.monitor.goodput.GoodputLedger` or Telemetry JSONL mirror
+picks them up with zero wiring:
+
+- ``serve_request_admitted``  {request_id, slot, queue_wait_s}
+- ``serve_queue_wait``        {seconds} — a timed goodput cause: time a
+  request sat in the queue because no slot was free
+- ``serve_request_completed`` {request_id, slot, new_tokens, ttft_s,
+  latency_s, finish_reason}
+- ``serve_request_evicted``   {request_id, slot, reason} — mid-stream
+  abort or shutdown; completed requests publish completed, not evicted
+- ``serve_decode_step``       {seconds, active} — per-step decode latency
+
+Aborts can be driven deterministically by the resilience
+:class:`~apex_tpu.resilience.fault_injection.FaultInjector`
+(``abort_request(request_id, at_step)``): the scheduler polls
+``serve_aborts_due`` before each decode step, which is how tier-1 proves a
+mid-stream abort leaves every other slot's output stream bit-identical
+under greedy decoding. (The engine's slot *arithmetic* is always
+isolated — logits never depend on other slots' bytes — but under
+``temperature > 0`` an abort changes backfill timing and with it the
+shared PRNG stream, so surviving requests' *sampled* tokens may differ.)
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Any, Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu.serve.engine import Engine
+from apex_tpu.utils.logging import publish_event
+
+
+# eq=False: the queue holds request objects, not values — a resubmitted
+# identical prompt must not alias an existing request in `in`/`remove`
+@dataclasses.dataclass(eq=False)
+class Request:
+    """One generation request and its accounting."""
+
+    request_id: Any
+    tokens: Sequence[int]                  # prompt token ids
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+
+    # filled in by the scheduler
+    generated: List[int] = dataclasses.field(default_factory=list)
+    state: str = "queued"     # queued|running|completed|evicted
+    finish_reason: Optional[str] = None   # eos|length|context|aborted
+    slot: Optional[int] = None
+    submit_t: Optional[float] = None
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None or self.submit_t is None:
+            return None
+        return self.first_token_t - self.submit_t
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.done_t is None or self.submit_t is None:
+            return None
+        return self.done_t - self.submit_t
+
+    def record(self) -> Dict[str, Any]:
+        out = {
+            "request_id": self.request_id, "state": self.state,
+            "finish_reason": self.finish_reason,
+            "prompt_tokens": len(self.tokens),
+            "new_tokens": len(self.generated),
+            "generated": list(self.generated),
+        }
+        for k in ("ttft_s", "latency_s"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = round(v, 6)
+        lat = self.latency_s
+        if lat and self.generated:
+            out["tokens_per_s"] = round(len(self.generated) / lat, 3)
+        return out
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Aggregate accounting over a scheduler run."""
+
+    requests: List[Dict[str, Any]]
+    decode_steps: int
+    decode_step_s: List[float]
+    decode_tokens: int          # tokens produced BY decode steps
+    total_new_tokens: int       # includes each request's prefill-sampled
+    wall_s: float               # first token
+
+    def summary(self) -> Dict[str, Any]:
+        lat = sorted(self.decode_step_s)
+
+        def pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            i = min(len(lat) - 1, int(round(p * (len(lat) - 1))))
+            return lat[i]
+
+        ttfts = sorted(r["ttft_s"] for r in self.requests
+                       if "ttft_s" in r)
+        decode_s = sum(lat)
+        return {
+            "requests": len(self.requests),
+            "completed": sum(r["state"] == "completed"
+                             for r in self.requests),
+            "evicted": sum(r["state"] == "evicted"
+                           for r in self.requests),
+            "decode_steps": self.decode_steps,
+            "new_tokens": self.total_new_tokens,
+            # decode throughput: decode-produced tokens over decode-step
+            # time ONLY — prefill-sampled first tokens ride TTFT, not this
+            # rate, so the bench headline tracks the decode hot path and
+            # not the run's admission pattern
+            "tokens_per_s": round(
+                self.decode_tokens / decode_s, 3) if decode_s else 0.0,
+            "p50_step_ms": round(pct(0.50) * 1e3, 3),
+            "p99_step_ms": round(pct(0.99) * 1e3, 3),
+            "ttft_p50_ms": round(
+                (ttfts[len(ttfts) // 2] if ttfts else 0.0) * 1e3, 3),
+            "wall_s": round(self.wall_s, 6),
+        }
+
+
+class ServeScheduler:
+    """Drive an :class:`Engine` over a request stream with continuous
+    batching. ``fault_injector`` (optional) supplies scripted mid-stream
+    aborts; a real deployment calls :meth:`abort` directly."""
+
+    def __init__(self, engine: Engine, *, fault_injector=None):
+        self.engine = engine
+        self.injector = fault_injector
+        self.queue: Deque[Request] = collections.deque()
+        self.slots: List[Optional[Request]] = \
+            [None] * engine.config.num_slots
+        self.done: List[Request] = []
+        self.decode_steps = 0
+        self.decode_step_s: List[float] = []
+        self.decode_tokens = 0
+        self._to_evict: set = set()   # slots freed, device reset pending
+        self._t0: Optional[float] = None
+
+    # --------------------------------------------------------- admission
+    def submit(self, req: Request) -> None:
+        if not len(req.tokens):
+            raise ValueError(f"request {req.request_id!r}: empty prompt")
+        if len(req.tokens) >= self.engine.max_len:
+            raise ValueError(
+                f"request {req.request_id!r}: prompt of {len(req.tokens)} "
+                f"tokens leaves no room to generate under max_len="
+                f"{self.engine.max_len}")
+        req.submit_t = time.perf_counter()
+        req.state = "queued"
+        self.queue.append(req)
+
+    def _admit(self) -> None:
+        """Fill free slots from the queue with ONE batched prefill call
+        (per shared pow2 bucket) and record each admitted request's first
+        sampled token."""
+        free = [i for i, r in enumerate(self.slots) if r is None]
+        if not free or not self.queue:
+            return
+        batch: Dict[int, Request] = {}
+        while free and self.queue:
+            slot = free.pop(0)
+            req = self.queue.popleft()
+            req.slot = slot
+            self.slots[slot] = req
+            batch[slot] = req
+        now = time.perf_counter()
+        for slot, req in batch.items():
+            req.admit_t = now
+            req.state = "running"
+            wait = max(now - req.submit_t, 0.0)
+            publish_event("serve_queue_wait", seconds=wait,
+                          request_id=req.request_id)
+            publish_event("serve_request_admitted",
+                          request_id=req.request_id, slot=slot,
+                          queue_wait_s=round(wait, 6))
+        first, _last_logits, _all = self.engine.prefill(
+            {slot: req.tokens for slot, req in batch.items()})
+        t_first = time.perf_counter()
+        for slot, req in batch.items():
+            req.first_token_t = t_first
+            self._accept_token(req, int(first[slot]))
+
+    # -------------------------------------------------------- lifecycle
+    def _accept_token(self, req: Request, tok: int) -> None:
+        req.generated.append(tok)
+        if req.eos_id is not None and tok == req.eos_id:
+            self._finish(req, "eos")
+        elif len(req.generated) >= req.max_new_tokens:
+            self._finish(req, "length")
+        elif len(req.tokens) + len(req.generated) >= self.engine.max_len:
+            self._finish(req, "context")
+
+    def _finish(self, req: Request, reason: str) -> None:
+        req.state = "completed"
+        req.finish_reason = reason
+        req.done_t = time.perf_counter()
+        self.done.append(req)
+        self._release(req)
+        publish_event("serve_request_completed",
+                      request_id=req.request_id, slot=req.slot,
+                      new_tokens=len(req.generated), finish_reason=reason,
+                      ttft_s=round(req.ttft_s or 0.0, 6),
+                      latency_s=round(req.latency_s or 0.0, 6))
+
+    def _release(self, req: Request) -> None:
+        # the device-side length reset is deferred and batched: several
+        # requests finishing on one tick cost ONE evict_slots call, and a
+        # slot backfilled on the next tick needs no eviction at all
+        # (prefill resets admitted slots itself)
+        if req.slot is not None and self.slots[req.slot] is req:
+            self.slots[req.slot] = None
+            self._to_evict.add(req.slot)
+
+    def _flush_evictions(self) -> None:
+        """One mask-shaped engine.evict for every slot freed since the
+        last flush, skipping slots a prefill already reclaimed."""
+        pending = {s for s in self._to_evict if self.slots[s] is None}
+        if pending:
+            self.engine.evict(sorted(pending))
+        self._to_evict.clear()
+
+    def abort(self, request_id) -> bool:
+        """Mid-stream abort: evict a running request (or drop it from the
+        queue). Other slots are untouched — bit-identical, by the static
+        shapes of the engine."""
+        for req in list(self.queue):
+            if req.request_id == request_id:
+                self.queue.remove(req)
+                self._evict(req, "aborted")
+                return True
+        for req in self.slots:
+            if req is not None and req.request_id == request_id:
+                self._evict(req, "aborted")
+                return True
+        return False
+
+    def _evict(self, req: Request, reason: str) -> None:
+        req.state = "evicted"
+        req.finish_reason = reason
+        req.done_t = time.perf_counter()
+        self.done.append(req)
+        self._release(req)
+        publish_event("serve_request_evicted", level="warning",
+                      request_id=req.request_id, slot=req.slot,
+                      reason=reason)
+
+    # ------------------------------------------------------------- steps
+    def step(self) -> bool:
+        """One scheduler tick: scripted aborts -> backfill -> one decode
+        step -> per-slot termination. Returns False when idle (no running
+        or queued work)."""
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+        if self.injector is not None:
+            for rid in self.injector.serve_aborts_due(self.decode_steps):
+                self.abort(rid)
+        self._admit()
+        active = np.array([r is not None for r in self.slots], bool)
+        if not active.any():
+            return bool(self.queue)
+        t0 = time.perf_counter()
+        next_tokens, _logits = self.engine.decode_step(
+            self.engine.last_tokens, active)
+        dt = time.perf_counter() - t0
+        self.decode_steps += 1
+        self.decode_step_s.append(dt)
+        self.decode_tokens += int(active.sum())
+        publish_event("serve_decode_step", seconds=dt,
+                      active=int(active.sum()))
+        for slot, req in enumerate(self.slots):
+            if req is not None:
+                self._accept_token(req, int(next_tokens[slot]))
+        self._flush_evictions()
+        return any(r is not None for r in self.slots) or bool(self.queue)
+
+    def run(self, max_steps: Optional[int] = None) -> ServeStats:
+        """Run until idle (or ``max_steps`` decode steps); returns stats.
+        Unfinished requests are evicted with reason ``shutdown``."""
+        while self.step():
+            if max_steps is not None and self.decode_steps >= max_steps:
+                break
+        for req in list(self.queue) + [r for r in self.slots
+                                       if r is not None]:
+            if req in self.queue:
+                self.queue.remove(req)
+            self._evict(req, "shutdown")
+        self._flush_evictions()
+        return self.stats()
+
+    def stats(self) -> ServeStats:
+        wall = (time.perf_counter() - self._t0) if self._t0 else 0.0
+        records = [r.record() for r in self.done]
+        return ServeStats(requests=records,
+                          decode_steps=self.decode_steps,
+                          decode_step_s=list(self.decode_step_s),
+                          decode_tokens=self.decode_tokens,
+                          total_new_tokens=sum(r["new_tokens"]
+                                               for r in records),
+                          wall_s=wall)

@@ -67,26 +67,51 @@ def _rope_cached_bwd(res, dy):
 _rope_cached.defvjp(_rope_cached_fwd, _rope_cached_bwd)
 
 
+def _offset_slice(table: jax.Array, position_offset, s: int) -> jax.Array:
+    """Rows ``position_offset .. position_offset+s`` of a positional table
+    whose axis 0 is the position axis. ``position_offset`` may be a python
+    int or a traced int32 scalar (a serving decode step's position); the
+    table must cover ``position_offset + s`` rows. The static-zero case
+    stays a plain slice so existing jaxprs are unchanged."""
+    if isinstance(position_offset, int) and position_offset == 0:
+        return table[:s]
+    return jax.lax.dynamic_slice_in_dim(table, position_offset, s, 0)
+
+
 def fused_rope(t: jax.Array, freqs: jax.Array,
-               transpose_output_memory: bool = False) -> jax.Array:
-    """sbhd variant: t (s, b, h, d), freqs (s, 1, 1, d2) or (s, d2).
+               transpose_output_memory: bool = False, *,
+               position_offset=0) -> jax.Array:
+    """sbhd variant: t (s, b, h, d), freqs (s_max, 1, 1, d2) or (s_max, d2).
 
     ``transpose_output_memory`` is a CUDA memory-layout knob; XLA owns layout
     on TPU — accepted for parity, ignored.
+
+    ``position_offset`` rotates token row ``j`` of ``t`` by frequency row
+    ``position_offset + j`` — a single decode token at absolute position
+    ``p`` (``t`` of shape (1, b, h, d), ``position_offset=p``) gets exactly
+    the rotation token ``p`` of a full-sequence call gets. Accepts a traced
+    scalar, so a serving decode step can pass the slot's current length.
     """
     if freqs.ndim == 2:
         freqs = freqs[:, None, None, :]
+    freqs = _offset_slice(freqs, position_offset, t.shape[0])
     cos = jnp.cos(freqs.astype(_f32))
     sin = jnp.sin(freqs.astype(_f32))
     return _rope_cached(t, cos, sin)
 
 
-def fused_rope_cached(t: jax.Array, cos: jax.Array,
-                      sin: jax.Array) -> jax.Array:
-    """Cached-freqs variant (``fused_rope_forward_cached``)."""
+def fused_rope_cached(t: jax.Array, cos: jax.Array, sin: jax.Array, *,
+                      position_offset=0) -> jax.Array:
+    """Cached-freqs variant (``fused_rope_forward_cached``).
+
+    ``position_offset`` indexes the cos/sin tables at the tokens' absolute
+    positions (axis 0 = position), same contract as :func:`fused_rope`.
+    """
     while cos.ndim < t.ndim:
         cos = jnp.expand_dims(cos, 1)
         sin = jnp.expand_dims(sin, 1)
+    cos = _offset_slice(cos, position_offset, t.shape[0])
+    sin = _offset_slice(sin, position_offset, t.shape[0])
     return _rope_cached(t, cos.astype(_f32), sin.astype(_f32))
 
 

@@ -41,6 +41,7 @@ CODE_VERSIONS = {
     "softmax_causal_chunked": 1,
     "group_norm": 1,
     "flash_attention": 1,
+    "decode_attention": 1,
     "fused_adam": 1,
     "fused_sgd": 1,
     "fused_lamb": 1,

@@ -331,6 +331,57 @@ def _fa_build(shape, dtype, params, interpret=None):
     return step, q, (k, v)
 
 
+# ----------------------------------------------------- decode_attention
+
+
+def _da_shape_key(shape) -> ShapeKey:
+    # max_len keyed exactly (layout-defining static engine constant; the
+    # winner must divide it) — matches serve.attention.resolve_block_k
+    return (("max_len", int(shape["max_len"])),
+            ("heads", int(shape["heads"])),
+            ("d", int(shape["d"])))
+
+
+def _da_defaults(shape):
+    from apex_tpu.ops.pallas.tiling import decode_attention_block
+
+    return {"block_k": decode_attention_block(int(shape["max_len"]))}
+
+
+def _da_candidates(shape):
+    L = int(shape["max_len"])
+    cands = [{"block_k": bk} for bk in (128, 256, 512, 1024, 2048)
+             if bk <= L and L % bk == 0]
+    default = _da_defaults(shape)
+    if default not in cands:
+        cands.append(default)
+    return cands
+
+
+def _da_build(shape, dtype, params, interpret=None):
+    import jax
+
+    from apex_tpu.serve.attention import cached_attention
+
+    b = int(shape.get("b", 8))
+    L, h, d = (int(shape["max_len"]), int(shape["heads"]),
+               int(shape["d"]))
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, h, d), dtype) * 0.2
+    kc = jax.random.normal(ks[1], (b, L, h, d), dtype) * 0.2
+    vc = jax.random.normal(ks[2], (b, L, h, d), dtype) * 0.2
+    import jax.numpy as jnp
+
+    positions = jnp.full((b,), L - 1, jnp.int32)  # worst case: full cache
+    bk = params["block_k"]
+
+    def step(i, q, kc, vc):
+        return cached_attention(q, kc, vc, positions, block_k=bk,
+                                interpret=interpret)
+
+    return step, q, (kc, vc)
+
+
 # ------------------------------------------------------ flat optimizers
 
 
@@ -491,6 +542,10 @@ _register(KernelSpec(
     _fa_build,
     default_shapes=({"b": 4, "h": 16, "sq": 2048, "sk": 2048, "d": 64,
                      "causal": True},)))
+_register(KernelSpec(
+    "decode_attention", _da_shape_key, _da_defaults, _da_candidates,
+    _da_build,
+    default_shapes=({"b": 8, "max_len": 2048, "heads": 16, "d": 64},)))
 _register(KernelSpec(
     "fused_adam", _flat_shape_key, _flat_defaults, _flat_candidates,
     _adam_build, default_shapes=({"numel": 134_217_728},),

@@ -29,6 +29,10 @@ def _run(args):
                   "--batch", "4"], marks=pytest.mark.slow),
     ["examples/lm_pretrain/main_fused_head.py", "--steps", "3",
      "--vocab-chunk", "128"],
+    # the serve CLI smoke in tests/test_serve.py covers the same engine
+    # path in tier-1; the example subprocess rides the slow tier
+    pytest.param(["examples/serve/generate.py", "--requests", "3",
+                  "--max-new-tokens", "3"], marks=pytest.mark.slow),
 ])
 def test_example_runs(args):
     r = _run(args)

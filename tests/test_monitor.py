@@ -200,6 +200,46 @@ def test_distributed_resilience_events_registered():
     assert s["events"]["collective_stall"] == 1
 
 
+def test_serve_events_registered():
+    """Every serving event the serve package publishes must be part of
+    the goodput event schema: queue wait is a timed cause, the request
+    lifecycle and per-step latency are counted signals. The source grep
+    makes an UNREGISTERED serve_* event a tier-1 failure, the same
+    contract PR-4 established for the distributed-resilience events."""
+    import os
+    import re
+
+    import apex_tpu.serve as serve_pkg
+    from apex_tpu.monitor.goodput import COUNTED_EVENTS, STALL_EVENTS
+
+    assert STALL_EVENTS["serve_queue_wait"] == "serve_queue_wait"
+    for name in ("serve_request_admitted", "serve_request_completed",
+                 "serve_request_evicted", "serve_decode_step"):
+        assert name in COUNTED_EVENTS, name
+
+    published = set()
+    pkg_dir = os.path.dirname(serve_pkg.__file__)
+    for fname in os.listdir(pkg_dir):
+        if fname.endswith(".py"):
+            with open(os.path.join(pkg_dir, fname)) as f:
+                published |= set(re.findall(
+                    r'publish_event\(\s*"(serve_[a-z_]+)"', f.read()))
+    assert published, "serve package publishes no events?"
+    unregistered = published - set(COUNTED_EVENTS) - set(STALL_EVENTS)
+    assert not unregistered, \
+        f"serve events missing from the goodput schema: {unregistered}"
+
+    with GoodputLedger() as led:
+        publish_event("serve_queue_wait", seconds=0.5, request_id="r0")
+        publish_event("serve_request_admitted", request_id="r0", slot=1)
+        publish_event("serve_decode_step", seconds=0.001, active=2)
+        publish_event("serve_request_completed", request_id="r0", slot=1)
+    s = led.summary()
+    assert s["lost_by_cause"]["serve_queue_wait"] == pytest.approx(0.5)
+    assert s["events"]["serve_request_admitted"] == 1
+    assert s["events"]["serve_decode_step"] == 1
+
+
 def test_checkpoint_save_publishes_stall_event(tmp_path):
     # call-time imports for BOTH sides: test_chip_worker's module purge can
     # leave collection-time and re-imported apex_tpu identities coexisting,

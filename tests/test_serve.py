@@ -1,0 +1,482 @@
+"""Serving engine tier-1: static-shape KV cache, one-jit decode,
+continuous batching.
+
+The acceptance claims under test:
+
+- **parity** — incremental decode logits are bit-identical (fp32) to
+  full-sequence prefill logits: prefill and decode share ONE single-token
+  forward at one fixed ``[num_slots]`` shape, so there is no second
+  numeric path to drift;
+- **one compile** — a scripted trace that admits, completes, evicts, and
+  backfills requests mid-stream traces ``decode_step`` exactly once
+  (``Engine.decode_traces``);
+- **isolation** — a FaultInjector-scripted mid-stream abort leaves every
+  other request's token stream bit-identical (per-slot reductions cannot
+  see other slots' bytes);
+- termination (EOS / max-new-tokens / context), greedy + seeded-sampling
+  determinism, the serve bench + regression gate, and both CLIs.
+
+Engines are compiled once per geometry and shared across tests via
+``Engine.reset()`` (state drop, zero recompiles — itself part of the
+serving contract); the one-jit acceptance tests get fresh engines so
+their trace counters stay airtight.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt2 import GPT2Config
+from apex_tpu.monitor.goodput import GoodputLedger
+from apex_tpu.resilience.fault_injection import FaultInjector
+from apex_tpu.serve.engine import Engine, EngineConfig, init_gpt2_params
+from apex_tpu.serve.kv_cache import init_cache, write_token
+from apex_tpu.serve.scheduler import Request, ServeScheduler
+
+pytestmark = pytest.mark.serve
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                 n_head=2, compute_dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_gpt2_params(CFG, seed=0)
+
+
+def _engine(params, **kw):
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("temperature", 0.0)
+    seed = kw.pop("seed", 0)
+    return Engine(CFG, params, EngineConfig(**kw), seed=seed)
+
+
+@pytest.fixture(scope="module")
+def greedy3(params):
+    """Shared greedy 3-slot engine; tests reset() it — compiled once."""
+    return _engine(params)
+
+
+@pytest.fixture(scope="module")
+def greedy2(params):
+    return _engine(params, num_slots=2)
+
+
+@pytest.fixture(scope="module")
+def keeper3(params):
+    """3-slot greedy engine that keeps per-position prefill logits."""
+    return _engine(params, keep_prefill_logits=True)
+
+
+def _tokens(n, seed=7, vocab=97):
+    rng = np.random.RandomState(seed)
+    return [int(t) for t in rng.randint(0, vocab, n)]
+
+
+# ------------------------------------------------------------ kv cache
+
+def test_kv_cache_ops_are_static_and_masked():
+    cache = init_cache(n_layer=2, num_slots=4, max_len=16, heads=2,
+                       head_dim=8)
+    k = jnp.ones((4, 2, 8)) * jnp.arange(1, 5)[:, None, None]
+    pos = jnp.zeros((4,), jnp.int32)
+    mask = jnp.array([True, False, True, False])
+    out = jax.jit(write_token, static_argnums=1)(cache, 0, k, k, pos, mask)
+    assert out.k.shape == cache.k.shape  # static shapes, whatever the mask
+    got = np.asarray(out.k[0, :, 0, 0, 0])
+    np.testing.assert_array_equal(got, [1.0, 0.0, 3.0, 0.0])
+    # masked-off slots' bytes are bit-untouched
+    np.testing.assert_array_equal(np.asarray(out.k[0, 1]),
+                                  np.asarray(cache.k[0, 1]))
+
+
+# -------------------------------------------------------------- parity
+
+def test_prefill_vs_incremental_decode_bit_exact(greedy3, keeper3):
+    """THE serving invariant: decode token j's logits == full prefill's
+    position-j logits, bit-for-bit in fp32."""
+    seq = _tokens(12)
+    _, _, all_logits = keeper3.reset().prefill({1: seq})
+    all_logits = np.asarray(all_logits)          # [P, B, V]
+
+    inc = greedy3.reset()
+    inc.prefill({1: seq[:5]})
+    for j in range(5, len(seq)):
+        forced = np.array([0, seq[j], 0], np.int32)
+        _, logits = inc.decode_step(forced, np.array([False, True, False]))
+        a, b = all_logits[j, 1], np.asarray(logits)[1]
+        assert a.dtype == np.float32
+        assert np.array_equal(a, b), \
+            f"decode pos {j} drifted: max|d|={np.abs(a - b).max()}"
+    assert inc.lengths[1] == len(seq)
+
+
+def test_prefill_last_logits_match_kept_logits(keeper3):
+    seq = _tokens(9, seed=3)
+    _, last, all_logits = keeper3.reset().prefill({0: seq})
+    np.testing.assert_array_equal(np.asarray(last)[0],
+                                  np.asarray(all_logits)[len(seq) - 1, 0])
+
+
+# ----------------------------------------------------- one-jit invariant
+
+def test_decode_compiles_once_across_admit_evict_backfill(params):
+    """Scripted multi-request trace — staggered admissions, completions,
+    a mid-stream abort, and backfill — compiles decode_step exactly once
+    and one prefill per prompt bucket. Fresh engine: the trace counters
+    are the assertion."""
+    eng = _engine(params, num_slots=2)
+    inj = FaultInjector(seed=0).abort_request("r2", at_step=4)
+    sched = ServeScheduler(eng, fault_injector=inj)
+    for i, plen in enumerate((4, 6, 5, 3, 7)):
+        sched.submit(Request(request_id=f"r{i}",
+                             tokens=_tokens(plen, seed=i),
+                             max_new_tokens=4 + i % 3))
+    stats = sched.run()
+    assert len(stats.requests) == 5
+    assert {r["state"] for r in stats.requests} == {"completed", "evicted"}
+    assert eng.decode_traces == 1, \
+        "slot membership changes must not retrace decode_step"
+    # prompts bucket to pow2: {4, 8} at most
+    assert eng.prefill_traces <= 2
+
+
+def test_aot_compile_then_serve_traces_once(params):
+    eng = _engine(params, num_slots=2).aot_compile(prompt_buckets=[8])
+    assert eng.decode_traces == 1
+    sched = ServeScheduler(eng)
+    for i in range(3):
+        sched.submit(Request(request_id=i, tokens=_tokens(6, seed=i),
+                             max_new_tokens=3))
+    sched.run()
+    assert eng.decode_traces == 1      # served entirely from the AOT exe
+    assert eng.prefill_traces == 1
+    # reset drops state but keeps the compiled artifacts
+    eng.reset()
+    assert np.asarray(eng.cache.lengths).max() == 0
+    sched = ServeScheduler(eng)
+    sched.submit(Request(request_id="again", tokens=_tokens(6),
+                         max_new_tokens=2))
+    sched.run()
+    assert eng.decode_traces == 1 and eng.prefill_traces == 1
+
+
+# --------------------------------------------------------- termination
+
+def test_eos_terminates_request(greedy2):
+    # greedy decode is deterministic: discover the first generated token,
+    # then rerun with that token as EOS — must stop after exactly 1 token
+    sched = ServeScheduler(greedy2.reset())
+    sched.submit(Request(request_id="probe", tokens=_tokens(5),
+                         max_new_tokens=4))
+    first = sched.run().requests[0]["generated"][0]
+
+    sched2 = ServeScheduler(greedy2.reset())
+    sched2.submit(Request(request_id="eos", tokens=_tokens(5),
+                          max_new_tokens=16, eos_id=int(first)))
+    rec = sched2.run().requests[0]
+    assert rec["finish_reason"] == "eos"
+    assert rec["new_tokens"] == 1
+    assert rec["generated"][-1] == int(first)
+
+
+def test_max_new_tokens_terminates(greedy3):
+    sched = ServeScheduler(greedy3.reset())
+    sched.submit(Request(request_id=0, tokens=_tokens(5),
+                         max_new_tokens=5))
+    rec = sched.run().requests[0]
+    assert rec["finish_reason"] == "length"
+    assert rec["new_tokens"] == 5
+
+
+def test_context_full_terminates(greedy2):
+    eng = greedy2.reset()
+    sched = ServeScheduler(eng)
+    sched.submit(Request(request_id=0, tokens=_tokens(28),
+                         max_new_tokens=100))
+    rec = sched.run().requests[0]
+    assert rec["finish_reason"] == "context"
+    assert rec["new_tokens"] == 4          # 28 + 4 == max_len == 32
+    # slot freed at completion: lengths reset
+    assert eng.lengths.max() == 0
+    # the RAW engine refuses to decode a context-full slot (a clipped
+    # cache write would silently corrupt the newest K/V row)
+    eng.reset()
+    eng.prefill({0: _tokens(31)})
+    eng.decode_step(eng.last_tokens, np.array([True, False]))  # -> 32
+    with pytest.raises(ValueError, match="max_len"):
+        eng.decode_step(eng.last_tokens, np.array([True, False]))
+
+
+def test_oversized_prompt_rejected(greedy2):
+    sched = ServeScheduler(greedy2.reset())
+    with pytest.raises(ValueError, match="no room"):
+        sched.submit(Request(request_id=0, tokens=_tokens(32)))
+    with pytest.raises(ValueError, match="empty"):
+        sched.submit(Request(request_id=1, tokens=[]))
+
+
+# ----------------------------------------------- eviction isolation
+
+def _run_trace(eng, injector=None, n=4):
+    sched = ServeScheduler(eng.reset(), fault_injector=injector)
+    for i in range(n):
+        sched.submit(Request(request_id=f"r{i}", tokens=_tokens(5, seed=i),
+                             max_new_tokens=6))
+    sched.run()
+    return {r["request_id"]: r for r in sched.stats().requests}
+
+
+@pytest.mark.fault
+def test_mid_stream_abort_leaves_other_slots_bit_identical(greedy2):
+    """FaultInjector aborts r1 mid-decode; every other request's token
+    stream must match the abort-free run bit-for-bit (static shapes make
+    slot arithmetic independent of slot membership)."""
+    base = _run_trace(greedy2)
+    inj = FaultInjector(seed=0).abort_request("r1", at_step=2)
+    with GoodputLedger() as led:
+        faulted = _run_trace(greedy2, injector=inj)
+    assert faulted["r1"]["state"] == "evicted"
+    assert faulted["r1"]["finish_reason"] == "aborted"
+    for rid in ("r0", "r2", "r3"):
+        assert faulted[rid]["state"] == "completed"
+        assert faulted[rid]["generated"] == base[rid]["generated"], rid
+    assert led.summary()["events"]["serve_request_evicted"] == 1
+
+
+# -------------------------------------------------------- determinism
+
+def test_greedy_is_deterministic_and_argmax(greedy3, keeper3):
+    seq = _tokens(6)
+    first, last_logits, _ = keeper3.reset().prefill({0: seq})
+    assert first[0] == int(np.asarray(last_logits)[0].argmax())
+    runs = []
+    for _ in range(2):
+        s = ServeScheduler(greedy3.reset())
+        s.submit(Request(request_id=0, tokens=seq, max_new_tokens=8))
+        runs.append(s.run().requests[0]["generated"])
+    assert runs[0] == runs[1]
+
+
+def test_sampled_decode_replays_under_fixed_key(params):
+    eng = _engine(params, temperature=0.8, top_k=5)
+
+    def run(seed):
+        s = ServeScheduler(eng.reset(seed))
+        s.submit(Request(request_id=0, tokens=_tokens(6),
+                         max_new_tokens=8))
+        return s.run().requests[0]["generated"]
+
+    assert run(1) == run(1)          # threaded PRNG: same seed, same stream
+    assert run(1) != run(2)          # and the key actually matters
+
+
+def test_top_k_restricts_to_top_k(params, keeper3):
+    seq = _tokens(6)
+    _, last_logits, _ = keeper3.reset().prefill({0: seq})
+    top5 = set(np.argsort(np.asarray(last_logits)[0])[-5:].tolist())
+    eng = _engine(params, temperature=1.5, top_k=5)
+    for seed in range(2):
+        first, _, _ = eng.reset(seed).prefill({0: seq})
+        assert int(first[0]) in top5
+
+
+# -------------------------------------------------- scheduler / events
+
+def test_backfill_and_queue_wait_accounting(greedy2):
+    with GoodputLedger() as led:
+        sched = ServeScheduler(greedy2.reset())
+        for i in range(5):
+            sched.submit(Request(request_id=i, tokens=_tokens(5, seed=i),
+                                 max_new_tokens=3))
+        stats = sched.run()
+    s = stats.summary()
+    assert s["completed"] == 5
+    g = led.summary()
+    assert g["events"]["serve_request_admitted"] == 5
+    assert g["events"]["serve_request_completed"] == 5
+    assert g["events"]["serve_decode_step"] == stats.decode_steps
+    # 3 of 5 requests waited for a slot: queue-wait is a goodput cause
+    assert g["lost_by_cause"].get("serve_queue_wait", 0.0) > 0.0
+    assert s["tokens_per_s"] > 0
+    assert s["p99_step_ms"] >= s["p50_step_ms"] >= 0
+
+
+def test_stats_record_shape(greedy3):
+    sched = ServeScheduler(greedy3.reset())
+    sched.submit(Request(request_id="x", tokens=_tokens(5),
+                         max_new_tokens=2))
+    rec = sched.run().requests[0]
+    for key in ("request_id", "state", "finish_reason", "prompt_tokens",
+                "new_tokens", "generated", "ttft_s", "latency_s",
+                "tokens_per_s"):
+        assert key in rec, key
+
+
+# --------------------------------------------------- tuned geometry
+
+def test_decode_attention_block_drives_geometry(params):
+    """An explicit (valid) block_k changes the partial-reduction order but
+    both engine paths share it — parity must survive the non-default
+    geometry; an invalid one must be rejected loudly."""
+    seq = _tokens(8)
+    full = _engine(params, keep_prefill_logits=True, block_k=8)
+    _, _, all_logits = full.prefill({1: seq})
+    inc = _engine(params, block_k=8)
+    inc.prefill({1: seq[:4]})
+    for j in range(4, len(seq)):
+        forced = np.array([0, seq[j], 0], np.int32)
+        _, logits = inc.decode_step(forced,
+                                    np.array([False, True, False]))
+        assert np.array_equal(np.asarray(all_logits)[j, 1],
+                              np.asarray(logits)[1])
+    with pytest.raises(ValueError, match="divide"):
+        _engine(params, block_k=7)
+
+
+def test_decode_attention_registered_with_tune():
+    from apex_tpu.tune import CODE_VERSIONS
+    from apex_tpu.tune import registry
+
+    assert "decode_attention" in CODE_VERSIONS
+    spec = registry.spec("decode_attention")
+    shape = dict(spec.default_shapes[0])
+    cands = spec.candidates(shape)
+    assert spec.defaults(shape) in cands
+    # the build runs the real decode attention at a small geometry
+    small = {"b": 2, "max_len": 64, "heads": 2, "d": 8}
+    p = spec.defaults(small)
+    step, state, consts = spec.build(small, jnp.float32, p)
+    out = step(0, state, *consts)
+    assert out.shape == state.shape
+
+
+# ------------------------------------------------------------ CLIs
+
+def _cli_env():
+    env = dict(os.environ)
+    kept = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+            if p and "axon" not in p]
+    env["PYTHONPATH"] = os.pathsep.join(kept + [ROOT])
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_serve_cli_smoke():
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.serve.cli", "--config", "tiny",
+         "--requests", "3", "--prompt-len", "4", "--max-new-tokens", "4",
+         "--num-slots", "2", "--max-len", "32", "--temperature", "0",
+         "--aot"],
+        cwd=ROOT, env=_cli_env(), capture_output=True, text=True,
+        timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+    recs, summary = lines[:-1], lines[-1]
+    assert len(recs) == 3
+    assert all(rec["state"] == "completed" for rec in recs)
+    assert summary["decode_compiles"] == 1
+    assert summary["summary"]["new_tokens"] == 12
+
+
+@pytest.mark.slow
+def test_serve_cli_stdin_stream():
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.serve.cli", "--stdin",
+         "--max-new-tokens", "2", "--num-slots", "2", "--max-len", "32",
+         "--temperature", "0"],
+        input="1 2 3\n7, 8, 9, 10\n", cwd=ROOT, env=_cli_env(),
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [json.loads(l) for l in r.stdout.strip().splitlines()]
+    assert len(lines) == 3
+    assert {rec["prompt_tokens"] for rec in lines[:-1]} == {3, 4}
+
+
+def test_serve_cli_rejects_bad_tokens():
+    # input validation runs BEFORE params/compile: this fails fast
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.serve.cli", "--stdin",
+         "--config", "tiny"],
+        input="999999\n", cwd=ROOT, env=_cli_env(), capture_output=True,
+        text=True, timeout=600)
+    assert r.returncode == 2
+    assert "vocab" in r.stderr
+
+
+def test_bench_serve_smoke_and_regression_gate(tmp_path, capsys):
+    """``apex-tpu-bench --serve`` emits the BENCH_SUITE shape; the
+    regression gate compares it direction-aware (latency lower-is-better,
+    throughput higher-is-better). In-process (the CLI smoke above covers
+    the subprocess entry; a second jax import would only burn budget)."""
+    from apex_tpu.bench_cli import _serve_bench
+
+    _serve_bench(steps=6, num_slots=2)
+    suite = json.loads(capsys.readouterr().out)
+    entry = suite["serve_decode"]
+    assert entry["value"] > 0 and entry["unit"] == "tokens_per_s"
+    for k in ("p50_ms", "p99_ms", "ttft_ms"):
+        assert entry[k] >= 0
+
+    base = dict(suite)
+    path_cur = tmp_path / "cur.json"
+    path_base = tmp_path / "base.json"
+    path_cur.write_text(json.dumps(suite))
+    path_base.write_text(json.dumps(base))
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_regression
+    finally:
+        sys.path.pop(0)
+    # identical capture: gate passes
+    assert check_regression.main([str(path_cur), "--suite",
+                                  str(path_base),
+                                  "--kernels", "serve_decode"]) == 0
+    # direction-aware: higher latency AND lower throughput both regress
+    worse = json.loads(json.dumps(suite))
+    worse["serve_decode"]["p99_ms"] = entry["p99_ms"] * 10 + 1
+    worse["serve_decode"]["value"] = entry["value"] / 10
+    path_cur.write_text(json.dumps(worse))
+    assert check_regression.main([str(path_cur), "--suite",
+                                  str(path_base),
+                                  "--kernels", "serve_decode"]) == 1
+    # ...and a FASTER capture (lower latency, higher tokens/s) passes
+    better = json.loads(json.dumps(suite))
+    better["serve_decode"]["p99_ms"] = entry["p99_ms"] / 10
+    better["serve_decode"]["value"] = entry["value"] * 10
+    path_cur.write_text(json.dumps(better))
+    assert check_regression.main([str(path_cur), "--suite",
+                                  str(path_base),
+                                  "--kernels", "serve_decode"]) == 0
+
+
+# --------------------------------------------- gpt2 position offsets
+
+def test_gpt2_learned_position_offset_parity(params):
+    """GPT2(position_offset=k) reads wpe[k:k+s] — proven by rolling the
+    embedding table: a model whose wpe is pre-shifted by k at offset 0
+    equals the original model at offset k."""
+    from apex_tpu.models.gpt2 import GPT2
+
+    model = GPT2(CFG)
+    tokens = jnp.asarray(np.array([_tokens(6, seed=5)], np.int32))
+    k = 9
+    inner = dict(params["params"])
+    wpe = np.asarray(params["params"]["wpe"])
+    inner["wpe"] = jnp.asarray(np.roll(wpe, -k, axis=0))
+    shifted = {"params": inner}
+    a = model.apply(params, tokens, position_offset=k)
+    b = model.apply(shifted, tokens)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # (traced offsets are exercised by the serve engine itself: prefill
+    # passes scan-carried positions through the same wpe slice)

@@ -138,6 +138,44 @@ class TestRoPE:
                                        np.asarray(ref[:, 0]), atol=1e-5)
             off += ln
 
+    def test_position_offset_single_token_parity(self):
+        """The serving contract: one decode token at absolute position t
+        rotates exactly like token t of the full-sequence call —
+        bit-identical (RoPE is elementwise per token row)."""
+        s, b, h, d = 12, 2, 3, 8
+        x = jax.random.normal(jax.random.PRNGKey(7), (s, b, h, d))
+        freqs = jax.random.normal(jax.random.PRNGKey(8), (s, d)) * 0.1
+        full = fused_rope(x, freqs)
+        for t in (0, 5, s - 1):
+            one = fused_rope(x[t:t + 1], freqs, position_offset=t)
+            np.testing.assert_array_equal(np.asarray(one),
+                                          np.asarray(full[t:t + 1]))
+        # a window (decode chunk) too, and under jit with a traced offset
+        # (tight-allclose there: XLA vectorizes cos/sin differently per
+        # fused shape, so cross-shape bitwise claims stop at eager ops)
+        win = fused_rope(x[4:9], freqs, position_offset=4)
+        np.testing.assert_array_equal(np.asarray(win),
+                                      np.asarray(full[4:9]))
+        jwin = jax.jit(lambda xx, off: fused_rope(xx, freqs,
+                                                  position_offset=off))
+        np.testing.assert_allclose(np.asarray(jwin(x[4:9], jnp.int32(4))),
+                                   np.asarray(full[4:9]), atol=1e-6,
+                                   rtol=0)
+
+    def test_position_offset_cached_variant_parity(self):
+        s, b, h, d = 10, 1, 2, 8
+        x = jax.random.normal(jax.random.PRNGKey(9), (s, b, h, d))
+        f = jax.random.normal(jax.random.PRNGKey(10), (s, d)) * 0.2
+        cos, sin = jnp.cos(f), jnp.sin(f)
+        full = fused_rope_cached(x, cos[:, None, None, :],
+                                 sin[:, None, None, :])
+        for t in (0, 3, s - 1):
+            one = fused_rope_cached(x[t:t + 1], cos[:, None, None, :],
+                                    sin[:, None, None, :],
+                                    position_offset=t)
+            np.testing.assert_array_equal(np.asarray(one),
+                                          np.asarray(full[t:t + 1]))
+
 
 class TestXentropy:
     @pytest.mark.parametrize("smoothing", [0.0, 0.1])

@@ -49,9 +49,15 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 # never compared: harness/bookkeeping and training-health values, not perf
+# (steps/slots are workload configuration — a shorter capture is not a
+# regression)
 EXCLUDED = {"step", "t", "bench_wall_s", "fetch_floor_ms", "found_inf",
-            "loss_scale", "grad_norm", "param_norm", "update_norm"}
+            "loss_scale", "grad_norm", "param_norm", "update_norm",
+            "steps", "slots"}
 _LOWER_SUFFIXES = ("_ms", "_s", "_latency")
+# serving latency names beat the generic rules ("ttft" carries no unit
+# suffix when reported in seconds; p50/p99 quantile columns are latencies)
+_LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate)
 _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
@@ -59,10 +65,16 @@ _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
 
 
 def lower_is_better(name: str, unit: Optional[str] = None) -> bool:
+    """Direction-aware comparison: serve metrics follow the same rules —
+    ``serve_decode`` (unit tokens_per_s) is higher-is-better while its
+    ``p50_ms``/``p99_ms``/``ttft_ms`` detail latencies are lower-is-better.
+    """
     lname = name.lower()
+    if unit and ("per_s" in unit or unit.endswith("/s")):
+        return False
     if any(h in lname for h in _HIGHER_HINTS):
         return False
-    if unit == "ms":
+    if unit == "ms" or any(h in lname for h in _LOWER_HINTS):
         return True
     return lname.endswith(_LOWER_SUFFIXES) or lname.endswith("loss")
 
