@@ -132,9 +132,20 @@ def _make_telemetry_step(batch: int = 8, seq: int = 33, vocab: int = 128,
     return jax.jit(step), state, tokens, float(batch * (seq - 1))
 
 
-def _telemetry_bench(jsonl_path: str, steps: int = 8,
-                     watchdog_timeout: "float | None" = None) -> None:
-    """Run the instrumented train loop and stream telemetry to JSONL."""
+def _telemetry_bench(jsonl_path: "str | None", steps: int = 8,
+                     watchdog_timeout: "float | None" = None,
+                     trace_jsonl: "str | None" = None,
+                     flight_path: "str | None" = None) -> None:
+    """Run the instrumented train loop and stream telemetry to JSONL.
+
+    ``trace_jsonl`` additionally enables span-tree tracing for the run
+    (one trace per step: ``train_step`` root over the jitted dispatch and
+    the completion fetch) exported as Perfetto-loadable Chrome-trace
+    JSON, plus per-step HBM sampling and the calibrated step's static
+    memory reservation. ``flight_path`` arms a crash-time flight
+    recorder: a preemption or watchdog escalation mid-bench leaves a
+    postmortem dump instead of a silent log tail.
+    """
     import contextlib
     import json
 
@@ -143,7 +154,17 @@ def _telemetry_bench(jsonl_path: str, steps: int = 8,
     from apex_tpu.monitor import Telemetry
 
     step, state, tokens, tokens_per_step = _make_telemetry_step()
-    tel = Telemetry(jsonl_path, tokens_per_step=tokens_per_step)
+    tel = Telemetry(jsonl_path, tokens_per_step=tokens_per_step,
+                    trace_jsonl=trace_jsonl)
+    mem = None
+    if trace_jsonl:
+        from apex_tpu.monitor.memory import MemoryAccountant
+        # every 16 steps: allocator reads are for trends, not hot loops
+        mem = MemoryAccountant(every=16)
+    flight = None
+    if flight_path:
+        from apex_tpu.monitor.flight import FlightRecorder
+        flight = FlightRecorder(flight_path, tracer=tel.tracer).attach()
     # optional collective watchdog: a step that wedges (stuck collective,
     # straggler host) becomes a collective_stall event in the JSONL —
     # visible in the capture — instead of a silently hung benchmark
@@ -151,25 +172,47 @@ def _telemetry_bench(jsonl_path: str, steps: int = 8,
     if watchdog_timeout:
         from apex_tpu.resilience import CollectiveWatchdog
         wd = CollectiveWatchdog(timeout_s=watchdog_timeout)
-    tel.calibrate(step, 0, state, tokens)  # MFU numerator: XLA cost model
-    # compile outside the timed window so row 1's step_ms is a step, not
-    # the trace+compile
-    state, tm = step(0, state, tokens)
-    jax.block_until_ready(tm)
-    tel.start()
-    for i in range(1, steps + 1):
-        with (wd.watch("train_step") if wd is not None
+    try:
+        # flight.guard: a fatal step exception (XLA error, OOM) has no
+        # bus record — the guard is what turns it into a postmortem dump
+        with (flight.guard("telemetry_bench") if flight is not None
               else contextlib.nullcontext()):
-            state, tm = step(i, state, tokens)
-            # the loop's ONE host transfer — the overflow flag it needs
-            # anyway; its data dependency also makes step_ms honest wall
-            # clock (and gives the watchdog a real completion boundary)
-            skipped = bool(jax.device_get(tm.found_inf))
-        tel.log_step(i, metrics=tm, skipped=skipped)
-    if wd is not None:
-        wd.stop()
-    tel.close()
-    summary = tel.summary()
+            tel.calibrate(step, 0, state, tokens)  # MFU from cost model
+            # compile outside the timed window so row 1's step_ms is a
+            # step, not the trace+compile
+            state, tm = step(0, state, tokens)
+            jax.block_until_ready(tm)
+            tel.start()
+            # per-step spans ONLY under --trace-jsonl: each tel.span
+            # publishes a "span" bus event, and the telemetry mirror
+            # appends one JSONL line per event — per-step writes are the
+            # price of opting into tracing, not of plain telemetry
+            # (whose events stay low-rate by design)
+            step_span = (tel.span if tel.tracer is not None
+                         else lambda name: contextlib.nullcontext())
+            for i in range(1, steps + 1):
+                with (wd.watch("train_step") if wd is not None
+                      else contextlib.nullcontext()):
+                    with step_span("train_step"):
+                        state, tm = step(i, state, tokens)
+                        # the loop's ONE host transfer — the overflow
+                        # flag it needs anyway; its data dependency also
+                        # makes step_ms honest wall clock (and gives the
+                        # watchdog a real completion boundary)
+                        skipped = bool(jax.device_get(tm.found_inf))
+                if mem is not None:
+                    mem.tick("train_step", step=i)
+                tel.log_step(i, metrics=tm, skipped=skipped)
+            summary = tel.summary()
+    finally:
+        # teardown runs on the failure path too: the recorder must not
+        # stay subscribed, the process tracer must be restored, and the
+        # Chrome trace must be terminated
+        if wd is not None:
+            wd.stop()
+        if flight is not None:
+            flight.detach()
+        tel.close()
     print(json.dumps({
         "metric": "telemetry_train_step_ms_lm_tiny",
         "value": round(summary["metrics"].get("step_ms", -1.0), 3),
@@ -220,6 +263,8 @@ def _serve_bench(steps: int, num_slots: int = 4,
     # must fail in milliseconds, not after the engine compiles and runs
     bench = _load_bench_module() if emit_baseline else None
 
+    from apex_tpu.utils.env import capture_provenance
+
     cfg = dataclasses.replace(GPT2Config.tiny(),
                               compute_dtype=jnp.float32)
     engine = Engine(cfg, init_gpt2_params(cfg),
@@ -243,6 +288,10 @@ def _serve_bench(steps: int, num_slots: int = 4,
     s = stats.summary()
     suite = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        # capture provenance: a CPU-smoke capture must be identifiable as
+        # one — check_regression flags a device_kind mismatch between
+        # capture and baseline instead of gating apples against oranges
+        **capture_provenance(),
         "serve_decode": {
             "metric": "serve_decode_tokens_per_s",
             "value": s["tokens_per_s"], "unit": "tokens_per_s",
@@ -320,9 +369,14 @@ def main() -> None:
     from apex_tpu.utils.logging import is_rank_zero, publish_event
 
     with PreemptionGuard(raise_on_signal=True) as guard:
-        has_telemetry = any(a == "--telemetry-jsonl"
-                            or a.startswith("--telemetry-jsonl=")
-                            for a in sys.argv[1:])
+        # --flight-recorder selects this mode too: silently dropping the
+        # flag would mean the requested postmortem recorder never armed —
+        # the exact silent-death failure it exists to prevent (with
+        # --serve/--kernels the mode-conflict check below refuses loudly)
+        has_telemetry = any(
+            a.split("=", 1)[0] in ("--telemetry-jsonl", "--trace-jsonl",
+                                   "--flight-recorder")
+            for a in sys.argv[1:])
         has_serve = any(a == "--serve" for a in sys.argv[1:])
         # --emit-baseline is shared by the serve and kernel-subset modes;
         # --kernels is NOT valid with --serve and must keep refusing
@@ -358,14 +412,23 @@ def main() -> None:
             import argparse
 
             ap = argparse.ArgumentParser(prog="apex-tpu-bench")
-            ap.add_argument("--telemetry-jsonl", required=True)
+            ap.add_argument("--telemetry-jsonl", default=None)
+            ap.add_argument("--trace-jsonl", default=None,
+                            help="write per-step span traces as "
+                                 "Perfetto-loadable Chrome-trace JSON "
+                                 "(usable with or without "
+                                 "--telemetry-jsonl)")
+            ap.add_argument("--flight-recorder", default=None,
+                            help="crash-time flight-recorder dump path")
             ap.add_argument("--steps", type=int, default=8)
             ap.add_argument("--watchdog-timeout", type=float, default=None,
                             help="seconds a train step may block before a "
                                  "collective_stall event lands in the JSONL")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _telemetry_bench(args.telemetry_jsonl, args.steps,
-                             watchdog_timeout=args.watchdog_timeout)
+                             watchdog_timeout=args.watchdog_timeout,
+                             trace_jsonl=args.trace_jsonl,
+                             flight_path=args.flight_recorder)
         elif has_subset:
             import argparse
 

@@ -1,7 +1,7 @@
 """apex_tpu.monitor — first-class training telemetry.
 
 The observability layer the reference never had (SURVEY §5: ad-hoc NVTX
-ranges and per-example AverageMeters). Three cooperating pieces:
+ranges and per-example AverageMeters). Cooperating pieces:
 
 - :mod:`~apex_tpu.monitor.metrics` — jit-safe :class:`TrainMetrics` pytree
   (grad/param/update norms, overflow flag, loss scale) collected INSIDE the
@@ -12,20 +12,40 @@ ranges and per-example AverageMeters). Three cooperating pieces:
   cost model, rank-0 gating on multihost.
 - :mod:`~apex_tpu.monitor.goodput` — :class:`GoodputLedger`: productive vs.
   lost step-time (overflow skips, checkpoint stalls, preemption), fed by
-  the resilience event stream.
+  the resilience event stream; also the registered event-name schema
+  (``EVENT_SCHEMA``) every bus publisher must use.
+- :mod:`~apex_tpu.monitor.trace` — request/step-scoped span-tree tracing
+  (:class:`Tracer`) with Perfetto/Chrome-trace export
+  (:class:`ChromeTraceWriter`), riding the same event bus.
+- :mod:`~apex_tpu.monitor.memory` — HBM accounting: sampled allocator
+  stats (:class:`MemoryAccountant`) and static XLA reservations at every
+  AOT point, as ``hbm_snapshot`` events.
+- :mod:`~apex_tpu.monitor.flight` — :class:`FlightRecorder`: bounded ring
+  of bus events + open spans + memory + thread stacks, dumped atomically
+  on watchdog escalation / preemption / fatal exceptions.
 
 ``tools/check_regression.py`` turns the emitted JSONL into a CI gate
 against a committed bench baseline. See docs/observability.md.
 """
 
-from apex_tpu.monitor.goodput import GoodputLedger  # noqa: F401
+from apex_tpu.monitor.flight import FlightRecorder, thread_stacks  # noqa: F401
+from apex_tpu.monitor.goodput import EVENT_SCHEMA, GoodputLedger  # noqa: F401
+from apex_tpu.monitor.memory import (  # noqa: F401
+    MemoryAccountant, device_memory_stats, publish_compiled_memory,
+    sample_device_memory)
 from apex_tpu.monitor.metrics import (  # noqa: F401
     TrainMetrics, collect_metrics, step_flops, tree_l2norm)
 from apex_tpu.monitor.telemetry import (  # noqa: F401
     PERF_ROW_KEYS, Telemetry, read_jsonl, validate_row)
+from apex_tpu.monitor.trace import (  # noqa: F401
+    ChromeTraceWriter, Span, Tracer, get_tracer, read_chrome_trace,
+    set_tracer, spans_by_trace)
 
 __all__ = [
-    "GoodputLedger", "TrainMetrics", "collect_metrics", "step_flops",
-    "tree_l2norm", "PERF_ROW_KEYS", "Telemetry", "read_jsonl",
-    "validate_row",
+    "GoodputLedger", "EVENT_SCHEMA", "TrainMetrics", "collect_metrics",
+    "step_flops", "tree_l2norm", "PERF_ROW_KEYS", "Telemetry", "read_jsonl",
+    "validate_row", "Tracer", "Span", "ChromeTraceWriter", "get_tracer",
+    "set_tracer", "read_chrome_trace", "spans_by_trace", "FlightRecorder",
+    "thread_stacks", "MemoryAccountant", "device_memory_stats",
+    "publish_compiled_memory", "sample_device_memory",
 ]

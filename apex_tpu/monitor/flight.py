@@ -1,0 +1,188 @@
+"""Crash-time flight recorder — a bounded postmortem of the last moments.
+
+A stalled collective, a preemption, or a fatal scheduler exception
+usually leaves nothing but a truncated log tail: the events that
+*explain* the death scrolled away long before it. The flight recorder
+keeps them:
+
+- a **bounded ring** (``capacity`` records) of every event on the
+  process bus — serve lifecycle, checkpoint stalls, overflow skips,
+  ``span_open``/``span_close``, ``hbm_snapshot`` — oldest dropped first,
+  so an event storm can never grow it (tier-1 proves the bound under a
+  FaultInjector overflow storm);
+- the tracer's **open spans** (what was in flight when it died);
+- the latest **hbm_snapshot** (was it an OOM death?);
+- an **all-thread Python stack dump** (where was every thread stuck?).
+
+``dump()`` writes one JSON artifact with the same ``.tmp`` +
+``os.replace`` atomicity as every other on-disk artifact in the repo
+(``tools/check_durability.py`` lints it): a dump torn by the very crash
+it documents would be worse than none. Auto-dump triggers, zero wiring
+beyond ``attach()`` — the trigger records already ride the bus:
+
+- ``preemption_requested`` (:class:`~apex_tpu.resilience.preemption.
+  PreemptionGuard` signal/agreement),
+- ``collective_stall`` with ``escalate`` dump/abort and
+  ``collective_stall_abort`` (:class:`~apex_tpu.resilience.distributed.
+  CollectiveWatchdog` escalation).
+
+Fatal exceptions have no bus record — wrap the region in
+:meth:`FlightRecorder.guard` (the serve scheduler's ``run()`` does when
+given a recorder). See docs/observability.md "Tracing and postmortems".
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.utils.logging import publish_event, subscribe_events
+
+SCHEMA_VERSION = 1
+
+
+def thread_stacks() -> Dict[str, List[str]]:
+    """Every thread's Python stack as ``{"tid:name": [frames...]}`` —
+    pure ``sys._current_frames`` so it works where faulthandler can't
+    (captured/replaced stderr). Shared with the collective watchdog's
+    stderr dump."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    try:
+        frames = sys._current_frames()
+    except Exception:
+        return out
+    for tid, frame in frames.items():
+        label = f"{tid}:{names.get(tid, '?')}"
+        out[label] = [line.rstrip("\n")
+                      for line in traceback.format_stack(frame)]
+    return out
+
+
+def _is_trigger(rec: Dict[str, Any]) -> bool:
+    """The bus records that mean "the run is dying — dump now"."""
+    ev = rec.get("event")
+    if ev in ("preemption_requested", "collective_stall_abort"):
+        return True
+    if ev == "collective_stall" and rec.get("escalate") in ("dump", "abort"):
+        return True
+    return False
+
+
+class FlightRecorder:
+    """Ring-buffer bus subscriber with an atomic postmortem dump.
+
+    Usage::
+
+        fr = FlightRecorder("run_flight.json", tracer=tracer).attach()
+        try:
+            serve_or_train()
+        finally:
+            fr.detach()
+        # a preemption / watchdog escalation mid-run left run_flight.json
+
+    ``tracer`` defaults to the process tracer
+    (:func:`~apex_tpu.monitor.trace.get_tracer`) at dump time, so open
+    spans appear whenever tracing is enabled. Repeat triggers overwrite
+    the dump atomically — the file always holds the LATEST complete
+    postmortem.
+    """
+
+    def __init__(self, path: str, *, capacity: int = 256, tracer=None,
+                 auto_dump: bool = True):
+        self.path = path
+        self.capacity = max(1, int(capacity))
+        self.tracer = tracer
+        self.auto_dump = auto_dump
+        self.events: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self.total_events = 0
+        self.dumps = 0
+        self.last_hbm: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+        self._unsubscribe = None
+
+    # ---- bus wiring ----------------------------------------------------
+    def attach(self) -> "FlightRecorder":
+        if self._unsubscribe is None:
+            self._unsubscribe = subscribe_events(self._on_event)
+        return self
+
+    def detach(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+
+    def __enter__(self) -> "FlightRecorder":
+        return self.attach()
+
+    def __exit__(self, *exc) -> None:
+        self.detach()
+
+    def _on_event(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self.total_events += 1
+            self.events.append(rec)
+            if rec.get("event") == "hbm_snapshot":
+                self.last_hbm = rec
+        if self.auto_dump and _is_trigger(rec):
+            self.dump(reason=str(rec.get("event")))
+
+    # ---- the postmortem ------------------------------------------------
+    def snapshot(self, reason: str) -> Dict[str, Any]:
+        """The dump payload (pure data; tests assert this schema)."""
+        from apex_tpu.monitor.trace import get_tracer
+
+        tracer = self.tracer if self.tracer is not None else get_tracer()
+        with self._lock:
+            events = list(self.events)
+            total = self.total_events
+            last_hbm = self.last_hbm
+        return {
+            "schema": SCHEMA_VERSION,
+            "reason": reason,
+            "t": time.time(),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "total_events": total,
+            "dropped_events": max(0, total - len(events)),
+            "events": events,
+            "open_spans": tracer.open_spans(),
+            "hbm_snapshot": last_hbm,
+            "thread_stacks": thread_stacks(),
+        }
+
+    def dump(self, reason: str = "manual") -> str:
+        """Write the postmortem atomically (stage to ``.tmp``, publish
+        with one ``os.replace`` — a crash mid-dump leaves the previous
+        complete dump, never a torn one). Returns the path."""
+        payload = self.snapshot(reason)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True, default=str)
+        os.replace(tmp, self.path)
+        self.dumps += 1
+        publish_event("flight_recorder_dump", emit=False, path=self.path,
+                      reason=reason, events=len(payload["events"]),
+                      open_spans=len(payload["open_spans"]))
+        return self.path
+
+    @contextlib.contextmanager
+    def guard(self, what: str = "run"):
+        """Dump on any escaping exception (fatal engine/scheduler error —
+        the one death with no bus record to trigger on), then re-raise."""
+        try:
+            yield self
+        except BaseException as e:
+            try:
+                self.dump(reason=f"exception:{type(e).__name__}:{what}")
+            except Exception:
+                pass  # the postmortem must never mask the real error
+            raise

@@ -60,6 +60,25 @@ COUNTED_EVENTS = (
     "serve_request_evicted", "serve_decode_step",
 )
 
+# informational events: on the bus for tracing/provenance/postmortem
+# consumers (Telemetry mirror, ChromeTraceWriter, FlightRecorder); the
+# ledger neither times nor counts them — except hbm_snapshot, folded into
+# the summary's hbm section below
+INFO_EVENTS = (
+    "span", "span_open", "span_close",
+    "hbm_snapshot", "flight_recorder_dump",
+    "kernel_autotune", "kernel_autotune_failed", "tune_cache_corrupt",
+    "preemption_guard_inert",
+    "checkpoint_publish_failed", "checkpoint_quarantine_failed",
+)
+
+# THE event-name schema: every literal publish_event/structured_warning
+# call site in apex_tpu/ must use a name registered in one of the three
+# tables — tests/test_monitor.py audits the whole package source, so a
+# new subsystem cannot ship an unregistered event
+EVENT_SCHEMA = (frozenset(STALL_EVENTS) | frozenset(COUNTED_EVENTS)
+                | frozenset(INFO_EVENTS))
+
 _OVERFLOW_CAUSE = "overflow_skip"
 
 
@@ -79,6 +98,10 @@ class GoodputLedger:
         self.steps = 0
         self.skipped_steps = 0
         self.events: Dict[str, int] = {}
+        # hbm accounting (fed by hbm_snapshot records; monitor.memory)
+        self.hbm_samples = 0
+        self.hbm_peak_bytes = 0          # allocator peak (sampled kind)
+        self.hbm_static_peak_bytes = 0   # XLA reservation peak (static)
         self._unsubscribe: Optional[Callable[[], None]] = None
 
     # ---- event-bus wiring ----------------------------------------------
@@ -106,6 +129,17 @@ class GoodputLedger:
             self.record_stall(cause, float(rec.get("seconds", 0.0)))
         if name in STALL_EVENTS or name in COUNTED_EVENTS:
             self.events[name] = self.events.get(name, 0) + 1
+        elif name == "hbm_snapshot":
+            self.hbm_samples += 1
+            if rec.get("kind") == "static":
+                self.hbm_static_peak_bytes = max(
+                    self.hbm_static_peak_bytes,
+                    int(rec.get("reserved_bytes", 0)))
+            else:
+                self.hbm_peak_bytes = max(
+                    self.hbm_peak_bytes,
+                    int(rec.get("peak_bytes_in_use",
+                                rec.get("bytes_in_use", 0))))
 
     # ---- explicit accounting -------------------------------------------
     def record_step(self, seconds: float, productive: bool = True,
@@ -139,7 +173,7 @@ class GoodputLedger:
 
     def summary(self) -> Dict[str, Any]:
         total = self.productive_s + self.lost_s
-        return {
+        out = {
             "goodput_frac": (self.productive_s / total) if total > 0 else 1.0,
             "productive_s": round(self.productive_s, 6),
             "lost_s": round(self.lost_s, 6),
@@ -149,3 +183,14 @@ class GoodputLedger:
             "skipped_steps": self.skipped_steps,
             "events": dict(sorted(self.events.items())),
         }
+        if self.hbm_samples:
+            # memory report rides the goodput summary — the one place a
+            # run report already reads (the paged-KV HBM-win measurement
+            # foundation; see monitor.memory)
+            hbm: Dict[str, Any] = {"samples": self.hbm_samples}
+            if self.hbm_peak_bytes:
+                hbm["peak_bytes_in_use"] = self.hbm_peak_bytes
+            if self.hbm_static_peak_bytes:
+                hbm["static_peak_bytes"] = self.hbm_static_peak_bytes
+            out["hbm"] = hbm
+        return out

@@ -1,0 +1,169 @@
+"""HBM memory accounting — ``hbm_snapshot`` events from two sources.
+
+The ROADMAP's paged-KV-pool and low-precision-cache items both claim HBM
+wins; nothing in the stack could *measure* one. Two complementary
+measurements, both published as ``hbm_snapshot`` records on the process
+event bus (``emit=False`` — monitoring consumers subscribe; stderr stays
+quiet):
+
+- **sampled** (``kind="sampled"``) — :class:`MemoryAccountant` reads the
+  runtime allocator's ``device.memory_stats()`` (bytes in use, peak,
+  limit) per step/tick. Real numbers on TPU; CPU backends return no
+  stats and the accountant degrades to silence (never fake zeros).
+- **static** (``kind="static"``) — :func:`publish_compiled_memory` reads
+  XLA's own ``compiled.memory_analysis()`` (argument/output/temp bytes)
+  at every AOT point: serve decode + prompt buckets
+  (``Engine.aot_compile``), the telemetry bench's calibrated step
+  (``Telemetry.calibrate``), and autotuner sweeps. Works on every
+  backend, CPU smoke included — it is the compiler's reservation, not an
+  allocator sample.
+
+The :class:`~apex_tpu.monitor.goodput.GoodputLedger` folds both into its
+summary (``hbm`` section: allocator peak + static peak), and the flight
+recorder keeps the latest snapshot for its postmortem dump. See
+docs/observability.md "Tracing and postmortems".
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from apex_tpu.utils.logging import publish_event
+
+# allocator stats worth keeping when present (plus any other integer
+# field on backends that report a different set — never an empty record)
+_SAMPLED_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                 "largest_alloc_size", "bytes_reserved",
+                 "largest_free_block_bytes", "pool_bytes")
+
+# the device-side fields of CompiledMemoryStats (host_* mirrors skipped:
+# they are zero everywhere we run and double the record size)
+_STATIC_KEYS = ("argument_size_in_bytes", "output_size_in_bytes",
+                "temp_size_in_bytes", "alias_size_in_bytes",
+                "generated_code_size_in_bytes")
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """Integer allocator stats for ``device`` (default: first device), or
+    ``None`` when the backend exposes none (CPU) or is unreachable."""
+    if device is None:
+        import jax  # deferred: accounting must not force backend init
+
+        try:
+            device = jax.devices()[0]
+        except Exception:
+            return None
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    out = {k: int(stats[k]) for k in _SAMPLED_KEYS
+           if isinstance(stats.get(k), (int, float))}
+    if not out:  # unfamiliar backend: keep whatever integers it reports
+        out = {k: int(v) for k, v in stats.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    return out or None
+
+
+def memory_analysis_record(compiled) -> Optional[Dict[str, int]]:
+    """``compiled.memory_analysis()`` as a plain int dict (plus the
+    derived ``reserved_bytes`` total), or ``None`` when the executable
+    doesn't expose one."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if isinstance(ma, (list, tuple)):
+        ma = ma[0] if ma else None
+    if ma is None:
+        return None
+    out: Dict[str, int] = {}
+    for k in _STATIC_KEYS:
+        v = getattr(ma, k, None)
+        if isinstance(v, (int, float)):
+            out[k] = int(v)
+    if not out:
+        return None
+    out["reserved_bytes"] = (out.get("argument_size_in_bytes", 0)
+                             + out.get("output_size_in_bytes", 0)
+                             + out.get("temp_size_in_bytes", 0))
+    return out
+
+
+def publish_compiled_memory(name: str, compiled,
+                            **attrs: Any) -> Optional[Dict[str, int]]:
+    """Publish one static ``hbm_snapshot`` for a compiled executable (an
+    AOT point). Best-effort: returns the record, or ``None`` (and
+    publishes nothing) when no analysis is available."""
+    rec = memory_analysis_record(compiled)
+    if rec is None:
+        return None
+    publish_event("hbm_snapshot", emit=False, kind="static", name=name,
+                  **attrs, **rec)
+    return rec
+
+
+def sample_device_memory(tag: str, device=None,
+                         **attrs: Any) -> Optional[Dict[str, int]]:
+    """One-shot allocator sample published as a sampled ``hbm_snapshot``
+    (module-level convenience; loops wanting cadence control use
+    :class:`MemoryAccountant`)."""
+    stats = device_memory_stats(device)
+    if stats is None:
+        return None
+    publish_event("hbm_snapshot", emit=False, kind="sampled", tag=tag,
+                  **attrs, **stats)
+    return stats
+
+
+class MemoryAccountant:
+    """Per-step/tick allocator sampling with a cadence bound.
+
+    ``tick(tag)`` samples every ``every``-th call (a decode loop ticking
+    thousands of times per second should not read allocator stats on each
+    one); ``sample(tag)`` is unconditional. ``device`` is injectable for
+    tests; sampling is silent on backends with no stats.
+    """
+
+    def __init__(self, device=None, *, every: int = 1):
+        self.device = device
+        self.every = max(1, int(every))
+        self.samples = 0
+        self.last: Optional[Dict[str, int]] = None
+        self.peak_bytes_in_use = 0
+        self._ticks = 0
+        self._dead = False   # backend reported no stats: stop asking
+
+    def tick(self, tag: str, **attrs: Any) -> Optional[Dict[str, int]]:
+        self._ticks += 1
+        if self._dead or self._ticks % self.every:
+            return None
+        return self.sample(tag, **attrs)
+
+    def sample(self, tag: str, **attrs: Any) -> Optional[Dict[str, int]]:
+        if self._dead:
+            return None
+        if self.device is None:
+            # resolve once: a per-tick jax.devices() lookup on the decode
+            # hot path would cost more than the sample itself
+            import jax
+
+            try:
+                self.device = jax.devices()[0]
+            except Exception:
+                self._dead = True
+                return None
+        stats = sample_device_memory(tag, self.device, **attrs)
+        if stats is None:
+            # stat-less backend (CPU): the answer will not change — make
+            # every later tick() a single flag check, not a failed probe
+            self._dead = True
+            return None
+        self.samples += 1
+        self.last = stats
+        self.peak_bytes_in_use = max(
+            self.peak_bytes_in_use,
+            stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)))
+        return stats

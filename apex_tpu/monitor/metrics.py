@@ -90,14 +90,31 @@ def collect_metrics(grads: Any = None, params: Any = None,
         loss_scale=scale)
 
 
-def step_flops(fn, *args) -> float:
-    """XLA cost-model FLOPs for one call of ``fn(*args)`` — the MFU
-    numerator. ``fn`` may already be jitted (its ``lower`` is reused);
-    otherwise it is jitted for analysis only. Returns 0.0 when the backend
-    reports no cost analysis (interpret-mode CPU paths)."""
+def compile_for_analysis(fn, *args):
+    """Lower + compile ``fn(*args)`` for cost/memory analysis (an
+    already-jitted ``fn``'s lowering is reused; plain callables are
+    jitted for analysis only). Returns ``None`` when compilation fails —
+    analysis consumers degrade, they don't raise."""
     lower = fn.lower if hasattr(fn, "lower") else jax.jit(fn).lower
     try:
-        ca = lower(*args).compile().cost_analysis()
+        return lower(*args).compile()
+    except Exception:
+        return None
+
+
+def step_flops(fn, *args, compiled=None) -> float:
+    """XLA cost-model FLOPs for one call of ``fn(*args)`` — the MFU
+    numerator. Pass ``compiled`` (from :func:`compile_for_analysis`) to
+    reuse an executable a caller already has — ``Telemetry.calibrate``
+    derives FLOPs AND the static memory analysis from one compile.
+    Returns 0.0 when the backend reports no cost analysis
+    (interpret-mode CPU paths)."""
+    if compiled is None:
+        compiled = compile_for_analysis(fn, *args)
+    if compiled is None:
+        return 0.0
+    try:
+        ca = compiled.cost_analysis()
     except Exception:
         return 0.0
     ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})

@@ -114,7 +114,8 @@ class Telemetry:
                  rank_zero_only: bool = True,
                  goodput: bool = True,
                  mirror_events: bool = True,
-                 flush_every: int = 50):
+                 flush_every: int = 50,
+                 trace_jsonl: Optional[str] = None):
         if rank_zero_only:
             import jax
 
@@ -122,6 +123,19 @@ class Telemetry:
         else:
             self.enabled = True
         self.jsonl_path = jsonl_path if self.enabled else None
+        # span-tree tracing (monitor.trace): trace_jsonl enables the
+        # process tracer for this run and streams completed spans as a
+        # Perfetto/Chrome-trace JSON; close() restores the previous tracer
+        self.tracer = None
+        self._trace_writer = None
+        self._prev_tracer = None
+        if trace_jsonl and self.enabled:
+            from apex_tpu.monitor.trace import (ChromeTraceWriter, Tracer,
+                                                set_tracer)
+
+            self.tracer = Tracer(enabled=True)
+            self._prev_tracer = set_tracer(self.tracer)
+            self._trace_writer = ChromeTraceWriter(trace_jsonl)
         if self.jsonl_path:
             # per-RUN sink: truncate any previous capture — mixed-run rows
             # would silently skew check_regression's medians
@@ -147,8 +161,20 @@ class Telemetry:
         """Set ``flops_per_step`` from the XLA cost model of ``fn(*args)``
         (the compiled step function — already-jitted callables reuse their
         lowering). Inherits roofline's operand-byte caveats; see
-        docs/observability.md."""
-        self.flops_per_step = step_flops(fn, *args)
+        docs/observability.md. Also captures the step's STATIC memory
+        reservation (``compiled.memory_analysis()``) as an
+        ``hbm_snapshot`` event — the bench's AOT point for the memory
+        accounting layer (monitor.memory)."""
+        from apex_tpu.monitor.metrics import compile_for_analysis
+
+        # ONE lower+compile serves both the cost model and the memory
+        # analysis (step_flops without it would compile a second copy)
+        compiled = compile_for_analysis(fn, *args)
+        self.flops_per_step = step_flops(fn, *args, compiled=compiled)
+        if compiled is not None:
+            from apex_tpu.monitor.memory import publish_compiled_memory
+
+            publish_compiled_memory("calibrated_step", compiled)
         if tokens_per_step is not None:
             self.tokens_per_step = tokens_per_step
         return self
@@ -226,7 +252,11 @@ class Telemetry:
 
     def _on_event(self, rec: Dict[str, Any]) -> None:
         # the mirror: every bus record becomes one JSONL line alongside the
-        # metric rows (append-per-event; events are low-rate by design)
+        # metric rows (append-per-event; events are low-rate by design).
+        # span_open/span_close are the exception — they are per-span and
+        # belong in the dedicated Chrome-trace file, not the metric log
+        if rec.get("event") in ("span_open", "span_close"):
+            return
         with open(self.jsonl_path, "a") as f:
             f.write(json.dumps(rec, sort_keys=True, default=float) + "\n")
 
@@ -249,6 +279,14 @@ class Telemetry:
             self._unsubscribe = None
         if self.ledger is not None:
             self.ledger.detach()
+        if self._trace_writer is not None:
+            self._trace_writer.close()
+            self._trace_writer = None
+        if self._prev_tracer is not None:
+            from apex_tpu.monitor.trace import set_tracer
+
+            set_tracer(self._prev_tracer)
+            self._prev_tracer = None
 
     def __enter__(self) -> "Telemetry":
         return self.start()

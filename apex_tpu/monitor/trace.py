@@ -1,0 +1,356 @@
+"""Span-tree tracing — request/step-scoped causal context over the bus.
+
+PR-2 gave the process an event bus and a JSONL sink, but every record on
+it is an island: ``serve_queue_wait``, ``serve_decode_step``, checkpoint
+stalls, and ``kernel_autotune`` carry no causal thread tying one request
+or one train step together end to end. This module adds that thread:
+
+- :class:`Span` — one named range with ``trace_id``/``span_id``/
+  ``parent_id``, monotonic start/end, and attributes. A *trace* is the
+  tree of spans sharing a ``trace_id`` (one serve request, one train
+  step).
+- :class:`Tracer` — opens/closes spans and publishes each transition as a
+  ``span_open``/``span_close`` record on the existing
+  :func:`~apex_tpu.utils.logging.publish_event` bus (``emit=False`` —
+  tracing must never spam stderr), so every bus consumer (telemetry
+  mirror, goodput ledger, flight recorder) sees the same stream with
+  zero new wiring. Context-manager spans nest through a ``contextvars``
+  ambient parent AND enter a ``jax.profiler.TraceAnnotation`` so
+  host-side spans line up with the XLA device trace.
+- :class:`ChromeTraceWriter` — streams completed spans as Chrome-trace
+  ``"X"`` events (one JSON object per line inside a JSON array), the
+  format Perfetto and ``chrome://tracing`` load directly. Each trace gets
+  its own ``tid`` track, so a serving run renders as one row per request.
+
+The default process tracer is **disabled**: ``tracer.span(...)`` yields
+``None``, publishes nothing, and allocates nothing but a generator frame
+— instrumented hot paths (the serve scheduler tick, ``ResilientStep``)
+cost one ``is-enabled`` check when tracing is off, and nothing host-side
+ever traces into a jitted function either way (tier-1 asserts the serve
+one-compile invariant holds with tracing on). Enable per run via
+``Telemetry(trace_jsonl=...)``, ``apex-tpu-serve --trace-jsonl``,
+``apex-tpu-bench --trace-jsonl``, or :func:`set_tracer`.
+
+See docs/observability.md "Tracing and postmortems".
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import contextvars
+import itertools
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from apex_tpu.utils.logging import publish_event, subscribe_events
+
+# one process-wide origin for Chrome-trace timestamps: every span's
+# ``ts`` is microseconds since this module imported, so spans from
+# different tracers/threads share a timeline
+_EPOCH = time.perf_counter()
+
+# sentinel: "use the ambient contextvar parent" (None means "force root")
+_AMBIENT = object()
+
+
+class Span:
+    """One named range in a trace tree. Mutable until :meth:`Tracer.end`
+    stamps ``t1``; ``record()`` is the bus/JSON shape."""
+
+    __slots__ = ("trace_id", "span_id", "parent_id", "name", "t0", "t1",
+                 "status", "attrs")
+
+    def __init__(self, trace_id: str, span_id: int, parent_id: Optional[int],
+                 name: str, t0: float, attrs: Dict[str, Any]):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.t0 = t0
+        self.t1: Optional[float] = None
+        self.status = "ok"
+        self.attrs = attrs
+
+    @property
+    def dur_ms(self) -> Optional[float]:
+        return None if self.t1 is None else (self.t1 - self.t0) * 1e3
+
+    def record(self) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "t0": round(self.t0, 6),
+        }
+        if self.t1 is not None:
+            rec["t1"] = round(self.t1, 6)
+            rec["dur_ms"] = round(self.dur_ms, 3)
+            rec["status"] = self.status
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        return rec
+
+
+class Tracer:
+    """Span factory over the process event bus.
+
+    Two styles compose:
+
+    - **context-manager** (``with tracer.span("post_step"):``) for
+      regions with LIFO nesting on one thread — the ambient parent rides
+      a contextvar and the range mirrors into ``jax.profiler``'s device
+      trace;
+    - **manual** (``begin()`` / ``end()``) for lifecycles that open and
+      close across different callbacks — a serve request's ``queue`` span
+      opens at submit and closes ticks later at admission. Manual spans
+      accept explicit ``t0``/``t1`` stamps so they can reuse the
+      instrumented component's own clock reads (the serve scheduler's
+      TTFT arithmetic and its spans come from the SAME timestamps —
+      reconciliation is exact, not approximate).
+
+    Disabled tracers return ``None`` spans and publish nothing. Completed
+    spans are kept (bounded deque) for export and tests; open spans are
+    queryable for the flight recorder's "what was in flight" dump.
+    """
+
+    def __init__(self, enabled: bool = True, *, max_completed: int = 65536):
+        self.enabled = enabled
+        self._span_ids = itertools.count(1)
+        self._trace_ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._open: Dict[int, Span] = {}
+        self.completed: collections.deque = collections.deque(
+            maxlen=max_completed)
+        self._current: contextvars.ContextVar = contextvars.ContextVar(
+            "apex_tpu_current_span", default=None)
+
+    # ---- core ----------------------------------------------------------
+    def new_trace_id(self, hint: str = "trace") -> str:
+        return f"{hint}#{next(self._trace_ids)}"
+
+    def current(self) -> Optional[Span]:
+        return self._current.get()
+
+    def begin(self, name: str, *, parent: Optional[Span] = None,
+              trace_id: Optional[str] = None, t0: Optional[float] = None,
+              **attrs: Any) -> Optional[Span]:
+        """Open a span. ``parent`` wins over ``trace_id``; with neither,
+        the span roots a new trace. Returns ``None`` when disabled."""
+        if not self.enabled:
+            return None
+        if parent is not None:
+            trace_id = parent.trace_id
+        elif trace_id is None:
+            trace_id = self.new_trace_id(name)
+        span = Span(trace_id, next(self._span_ids),
+                    parent.span_id if parent is not None else None,
+                    name, t0 if t0 is not None else time.perf_counter(),
+                    dict(attrs))
+        with self._lock:
+            self._open[span.span_id] = span
+        publish_event("span_open", emit=False, **span.record())
+        return span
+
+    def end(self, span: Optional[Span], *, t1: Optional[float] = None,
+            status: str = "ok", **attrs: Any) -> None:
+        """Close a span (idempotent; ``None`` from a disabled begin is a
+        no-op, so call sites need no enabled-guard of their own)."""
+        if span is None or span.t1 is not None:
+            return
+        span.t1 = t1 if t1 is not None else time.perf_counter()
+        span.status = status
+        if attrs:
+            span.attrs.update(attrs)
+        with self._lock:
+            self._open.pop(span.span_id, None)
+            self.completed.append(span)
+        publish_event("span_close", emit=False, **span.record())
+
+    # ---- context-manager style -----------------------------------------
+    @contextlib.contextmanager
+    def span(self, name: str, *, parent: Any = _AMBIENT, **attrs: Any):
+        """Nested span: parent defaults to the ambient (contextvar) span;
+        pass ``parent=None`` to force a new root. The region also enters a
+        ``jax.profiler.TraceAnnotation`` so it shows in the device trace
+        timeline next to the XLA ops it encloses."""
+        if not self.enabled:
+            yield None
+            return
+        if parent is _AMBIENT:
+            parent = self._current.get()
+        s = self.begin(name, parent=parent, **attrs)
+        token = self._current.set(s)
+        ann = None
+        try:  # device-trace mirror is best-effort: no backend, no range
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None
+        try:
+            yield s
+        except BaseException:
+            self.end(s, status="error")
+            raise
+        finally:
+            if ann is not None:
+                try:
+                    ann.__exit__(None, None, None)
+                except Exception:
+                    pass
+            self._current.reset(token)
+            self.end(s)
+
+    def trace(self, name: str, **attrs: Any):
+        """Root-span context manager: always starts a NEW trace (ignores
+        any ambient parent) — one call, one trace tree."""
+        return self.span(name, parent=None, **attrs)
+
+    # ---- introspection -------------------------------------------------
+    def open_spans(self) -> List[Dict[str, Any]]:
+        """Records of the spans currently in flight (flight-recorder
+        food: "what was the process doing when it died")."""
+        with self._lock:
+            return [s.record() for s in
+                    sorted(self._open.values(), key=lambda s: s.span_id)]
+
+    def completed_records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [s.record() for s in self.completed]
+
+
+# --------------------------------------------------------------------------
+# default process tracer
+# --------------------------------------------------------------------------
+
+_default_tracer = Tracer(enabled=False)
+
+
+def get_tracer() -> Tracer:
+    """The process default tracer (disabled until a run enables one)."""
+    return _default_tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Install ``tracer`` as the process default; returns the previous one
+    so callers (``Telemetry``, the CLIs) can restore it on close."""
+    global _default_tracer
+    prev = _default_tracer
+    _default_tracer = tracer
+    return prev
+
+
+# --------------------------------------------------------------------------
+# Chrome-trace (Perfetto) export
+# --------------------------------------------------------------------------
+
+class ChromeTraceWriter:
+    """Stream ``span_close`` bus records to a Chrome-trace JSON file.
+
+    Output is the JSON Array Format: ``[`` then one complete (``"ph":
+    "X"``) event object per line. Perfetto and ``chrome://tracing``
+    tolerate a missing closing bracket, so a crashed run's partial file
+    still loads; :meth:`close` finalizes it into strict JSON. Each
+    distinct ``trace_id`` is assigned its own ``tid`` (with a thread-name
+    metadata event), so traces render as parallel tracks — one row per
+    serve request / train step.
+    """
+
+    def __init__(self, path: str, *, pid: Optional[int] = None):
+        import os
+
+        self.path = path
+        self.pid = pid if pid is not None else os.getpid()
+        self._tids: Dict[str, int] = {}
+        self._f = open(path, "w")
+        self._f.write("[")
+        self._wrote_any = False
+        # span_close records arrive on whichever thread closed the span
+        # (the Tracer is thread-safe, so that can be several at once) —
+        # the comma/newline framing must not interleave
+        self._lock = threading.Lock()
+        self.events = 0
+        self._unsubscribe = subscribe_events(self._on_event)
+
+    def _on_event(self, rec: Dict[str, Any]) -> None:
+        if rec.get("event") == "span_close":
+            self.write_span(rec)
+
+    def _emit(self, obj: Dict[str, Any]) -> None:
+        # caller holds self._lock
+        self._f.write(("," if self._wrote_any else "") + "\n"
+                      + json.dumps(obj, sort_keys=True, default=str))
+        self._wrote_any = True
+
+    def _tid(self, trace_id: str) -> int:
+        tid = self._tids.get(trace_id)
+        if tid is None:
+            tid = self._tids[trace_id] = len(self._tids) + 1
+            self._emit({"ph": "M", "name": "thread_name", "pid": self.pid,
+                        "tid": tid, "args": {"name": trace_id}})
+        return tid
+
+    def write_span(self, rec: Dict[str, Any]) -> None:
+        args = {"trace_id": rec.get("trace_id"),
+                "span_id": rec.get("span_id"),
+                "parent_id": rec.get("parent_id"),
+                "status": rec.get("status")}
+        args.update(rec.get("attrs") or {})
+        with self._lock:
+            if self._f.closed:
+                return
+            self._emit({
+                "ph": "X", "cat": "host", "name": rec.get("name", "?"),
+                "pid": self.pid,
+                "tid": self._tid(str(rec.get("trace_id"))),
+                "ts": round((float(rec["t0"]) - _EPOCH) * 1e6, 3),
+                "dur": round((float(rec["t1"]) - float(rec["t0"])) * 1e6,
+                             3),
+                "args": args,
+            })
+            self.events += 1
+            self._f.flush()  # low-rate; a crash keeps what completed
+
+    def close(self) -> None:
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+        with self._lock:
+            if not self._f.closed:
+                self._f.write("\n]\n")
+                self._f.close()
+
+    def __enter__(self) -> "ChromeTraceWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_chrome_trace(path: str) -> List[Dict[str, Any]]:
+    """Parse a Chrome-trace file, tolerating the unterminated array a
+    crashed run leaves behind (exactly what Perfetto tolerates)."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text.startswith("["):
+        raise ValueError(f"{path}: not a Chrome-trace JSON array")
+    if text.endswith(","):
+        text = text[:-1]
+    if not text.endswith("]"):
+        text += "]"
+    return json.loads(text)
+
+
+def spans_by_trace(records: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
+    """Group span records (bus ``span_close`` records or a tracer's
+    ``completed_records()``) by ``trace_id`` — one entry per request/step
+    trace, spans in id (open) order."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        out.setdefault(str(rec.get("trace_id")), []).append(rec)
+    for spans in out.values():
+        spans.sort(key=lambda r: r.get("span_id") or 0)
+    return out

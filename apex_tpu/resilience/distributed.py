@@ -44,13 +44,17 @@ import re
 import sys
 import threading
 import time
-import traceback
 import zlib
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
+# imported at module load, NOT in the escalation path: a first-time
+# package import on the watchdog thread while the main thread is wedged
+# (possibly holding an import lock) could block the very dump that is
+# supposed to diagnose the wedge
+from apex_tpu.monitor.flight import thread_stacks
 from apex_tpu.resilience.checkpoint_manager import (
     _OLD_SUFFIX, _TMP_SUFFIX, MANIFEST_NAME, MANIFEST_VERSION,
     CheckpointCorruptError, CheckpointError, CheckpointLayoutError,
@@ -397,16 +401,21 @@ class CollectiveWatchdog:
 
     def _dump_stacks(self, name: str, stream=None) -> None:
         """All-thread Python stack dump — the diagnostic a silent hang never
-        yields. Pure-Python (``sys._current_frames``) so it works where
-        faulthandler can't (captured/replaced stderr)."""
+        yields. Shares :func:`apex_tpu.monitor.flight.thread_stacks`
+        (pure ``sys._current_frames``, works where faulthandler can't) so
+        the stderr dump and a flight-recorder postmortem show the same
+        stacks. An attached :class:`~apex_tpu.monitor.flight.
+        FlightRecorder` also auto-dumps on this escalation — the
+        ``collective_stall`` record it sees carries ``escalate``."""
         stream = stream or sys.stderr
         try:
-            frames = sys._current_frames()
+            stacks = thread_stacks()
             print(f"collective_stall[{name}]: dumping "
-                  f"{len(frames)} thread stacks", file=stream)
-            for tid, frame in frames.items():
-                print(f"--- thread {tid} ---", file=stream)
-                traceback.print_stack(frame, file=stream)
+                  f"{len(stacks)} thread stacks", file=stream)
+            for label, frames in stacks.items():
+                print(f"--- thread {label} ---", file=stream)
+                for line in frames:
+                    print(line, file=stream)
             stream.flush()
         except Exception:
             pass  # diagnostics must never take down the watchdog thread

@@ -16,6 +16,13 @@ the same final sharded save together instead of one host saving step N
 while another saves N+1 (which a sharded checkpoint could never commit).
 Console announcements are gated to rank 0; the structured
 ``preemption_requested`` bus event still fires on every rank.
+
+Postmortems need no wiring here: an attached
+:class:`~apex_tpu.monitor.flight.FlightRecorder` auto-dumps on the
+``preemption_requested`` bus record itself, so a preempted run leaves its
+last-N events, open spans, and memory snapshot on disk alongside the
+final checkpoint — whichever of ``should_stop()``/``finalize()``/the
+``raise_on_signal`` unwind announces the preemption first.
 """
 
 from __future__ import annotations

@@ -25,6 +25,7 @@ loop needs anyway to count skips.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Callable, Dict
 
 import jax
@@ -68,10 +69,15 @@ class ResilientStep:
     def __init__(self, step_fn: Callable, scaler: DynamicGradScaler, *,
                  max_consecutive_overflows: int = 8,
                  scale_floor: float = DEFAULT_SCALE_FLOOR,
-                 telemetry=None):
+                 telemetry=None, tracer=None):
         self.step_fn = step_fn
         self.scaler = scaler
         self.telemetry = telemetry
+        # span-tree tracing (monitor.trace): one trace per train step —
+        # ``train_step`` root, ``forward_backward`` and
+        # ``unscale_grad_norm`` children — so a step's phases line up
+        # with the device trace and land in the flight recorder's ring
+        self.tracer = tracer
         self.last_metrics = None
         self._step_index = 0
         self.max_consecutive_overflows = max_consecutive_overflows
@@ -111,13 +117,27 @@ class ResilientStep:
         self._post = jax.jit(
             _post, static_argnames=("freeze_growth", "with_metrics"))
 
+    def _span(self, name: str, **attrs):
+        """A tracer span, or a free nullcontext when tracing is off — the
+        wrapped step pays one attribute check per phase, nothing more."""
+        if self.tracer is not None and self.tracer.enabled:
+            return self.tracer.span(name, **attrs)
+        return contextlib.nullcontext()
+
     def __call__(self, params: Any, sstate: ScalerState, *batch):
-        new_params, found_inf, *aux = self.step_fn(params, sstate, *batch)
+        with self._span("train_step", step=self._step_index):
+            return self._call(params, sstate, *batch)
+
+    def _call(self, params: Any, sstate: ScalerState, *batch):
+        with self._span("forward_backward"):
+            new_params, found_inf, *aux = self.step_fn(params, sstate,
+                                                       *batch)
         with_metrics = self.telemetry is not None
-        params, sstate, tm = self._post(new_params, params, sstate,
-                                        found_inf,
-                                        freeze_growth=self.degraded,
-                                        with_metrics=with_metrics)
+        with self._span("unscale_grad_norm"):
+            params, sstate, tm = self._post(new_params, params, sstate,
+                                            found_inf,
+                                            freeze_growth=self.degraded,
+                                            with_metrics=with_metrics)
         skipped = bool(found_inf)
         if with_metrics:
             self.last_metrics = tm

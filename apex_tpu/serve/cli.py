@@ -66,6 +66,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "serving (startup pays the trace, not traffic)")
     ap.add_argument("--telemetry-jsonl", default=None,
                     help="mirror serve_* bus events into this JSONL")
+    ap.add_argument("--trace-jsonl", default=None,
+                    help="write per-request span traces (queue/prefill/"
+                         "decode/complete) as Perfetto-loadable "
+                         "Chrome-trace JSON")
+    ap.add_argument("--flight-recorder", default=None,
+                    help="crash-time flight-recorder dump path: on "
+                         "preemption, watchdog escalation, or a fatal "
+                         "scheduler error, the last events + open spans "
+                         "+ memory snapshot land here atomically")
     args = ap.parse_args(argv)
 
     import jax.numpy as jnp
@@ -123,23 +132,46 @@ def main(argv: Optional[List[str]] = None) -> int:
                      temperature=args.temperature, top_k=args.top_k),
         seed=args.seed)
 
-    if args.aot:
-        engine.aot_compile([max(len(p) for p in prompts)])
-
-    tel = None
-    if args.telemetry_jsonl:
+    # one Telemetry owns the whole observability lifecycle: event mirror
+    # (--telemetry-jsonl), span tracer install/restore + Chrome-trace
+    # export (--trace-jsonl) — same wiring as apex-tpu-bench
+    tel = flight = mem = None
+    if args.telemetry_jsonl or args.trace_jsonl:
         from apex_tpu.monitor import Telemetry
 
-        tel = Telemetry(args.telemetry_jsonl)
+        tel = Telemetry(args.telemetry_jsonl,
+                        trace_jsonl=args.trace_jsonl)
+    tracer = tel.tracer if tel is not None else None
+    if args.trace_jsonl:
+        from apex_tpu.monitor.memory import MemoryAccountant
 
-    sched = ServeScheduler(engine)
+        # sampled every 16 decode ticks: an allocator read per tick would
+        # tax the decode hot path for a slowly-moving number
+        mem = MemoryAccountant(every=16)
+    if args.flight_recorder:
+        from apex_tpu.monitor.flight import FlightRecorder
+
+        flight = FlightRecorder(args.flight_recorder,
+                                tracer=tracer).attach()
+
+    if args.aot:
+        # after the observability wiring: the AOT compiles publish their
+        # static hbm_snapshot, which the sinks above must see
+        engine.aot_compile([max(len(p) for p in prompts)])
+
+    sched = ServeScheduler(engine, tracer=tracer, flight_recorder=flight,
+                           memory_accountant=mem)
     for i, toks in enumerate(prompts):
         sched.submit(Request(request_id=f"req-{i}", tokens=toks,
                              max_new_tokens=args.max_new_tokens,
                              eos_id=args.eos_id))
-    stats = sched.run()
-    if tel is not None:
-        tel.close()
+    try:
+        stats = sched.run()
+    finally:
+        if flight is not None:
+            flight.detach()
+        if tel is not None:
+            tel.close()
 
     for rec in stats.requests:
         print(json.dumps(rec, sort_keys=True))

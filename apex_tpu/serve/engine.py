@@ -167,10 +167,21 @@ class Engine:
     def aot_compile(self, prompt_buckets: Sequence[int] = ()) -> "Engine":
         """Lower + compile decode (and the given prompt-length buckets)
         ahead of the first request — startup pays the trace, not traffic.
+
+        Each fresh compile publishes its static XLA memory reservation as
+        an ``hbm_snapshot`` event (``apex_tpu.monitor.memory``) — the
+        serving AOT points are where the engine's HBM budget is decided,
+        and the paged-KV ROADMAP item needs them on the record.
         """
+        from apex_tpu.monitor.memory import publish_compiled_memory
+
         if self._decode_aot is None:
             self._decode_aot = self._decode.lower(
                 *self._decode_args()).compile()
+            publish_compiled_memory(
+                "serve_decode", self._decode_aot,
+                num_slots=self.config.num_slots, max_len=self.max_len,
+                kv_cache_bytes=self.kv_cache_bytes)
         for bucket in prompt_buckets:
             bucket = pow2_ceil(int(bucket))
             if bucket not in self._prefill_aot:
@@ -178,6 +189,10 @@ class Engine:
                     bucket, self._make_prefill(bucket))
                 self._prefill_aot[bucket] = fn.lower(
                     *self._prefill_args(bucket)).compile()
+                publish_compiled_memory(
+                    "serve_prefill", self._prefill_aot[bucket],
+                    bucket=bucket, num_slots=self.config.num_slots,
+                    max_len=self.max_len)
         return self
 
     def _init_state(self, seed: int) -> None:
@@ -283,6 +298,13 @@ class Engine:
     @property
     def lengths(self) -> np.ndarray:
         return np.asarray(self.cache.lengths)
+
+    @property
+    def kv_cache_bytes(self) -> int:
+        """Resident bytes of the static KV cache — the number the paged
+        pool (ROADMAP item 2) must beat; stamped into the serving AOT
+        ``hbm_snapshot`` so captures carry it."""
+        return int(self.cache.k.nbytes) + int(self.cache.v.nbytes)
 
 
 def init_gpt2_params(cfg: GPT2Config, seed: int = 0):

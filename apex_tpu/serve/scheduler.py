@@ -30,11 +30,24 @@ under greedy decoding. (The engine's slot *arithmetic* is always
 isolated — logits never depend on other slots' bytes — but under
 ``temperature > 0`` an abort changes backfill timing and with it the
 shared PRNG stream, so surviving requests' *sampled* tokens may differ.)
+
+**Tracing** (``tracer=``, a :class:`~apex_tpu.monitor.trace.Tracer`):
+every request becomes ONE trace — ``queue → prefill → decode →
+complete|evict|abort`` spans stamped from the scheduler's own
+``perf_counter`` reads, so span durations reconcile EXACTLY with the
+TTFT/latency accounting (``queue.dur == queue_wait``, ``queue + prefill
+== ttft``, ``root.dur == latency``) — plus a scheduler-level trace of
+per-tick ``decode_tick`` spans. With ``tracer=None`` (the default) no
+span code runs at all, and tracing never touches the device either way:
+the one-compile invariant holds with it on (asserted in tier-1).
+``flight_recorder=`` arms a crash dump around :meth:`run`;
+``memory_accountant=`` samples HBM per decode tick.
 """
 
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import time
 from typing import Any, Deque, Dict, List, Optional, Sequence
@@ -146,9 +159,17 @@ class ServeScheduler:
     batching. ``fault_injector`` (optional) supplies scripted mid-stream
     aborts; a real deployment calls :meth:`abort` directly."""
 
-    def __init__(self, engine: Engine, *, fault_injector=None):
+    def __init__(self, engine: Engine, *, fault_injector=None,
+                 tracer=None, flight_recorder=None, memory_accountant=None):
         self.engine = engine
         self.injector = fault_injector
+        # observability seams (all optional; None = zero work per tick)
+        self.tracer = tracer if tracer is not None and tracer.enabled \
+            else None
+        self.flight = flight_recorder
+        self.memory = memory_accountant
+        self._req_spans: Dict[Request, Dict[str, Any]] = {}
+        self._sched_span = None    # root of the scheduler's tick trace
         self.queue: Deque[Request] = collections.deque()
         self.slots: List[Optional[Request]] = \
             [None] * engine.config.num_slots
@@ -170,6 +191,18 @@ class ServeScheduler:
                 f"{self.engine.max_len}")
         req.submit_t = time.perf_counter()
         req.state = "queued"
+        if self.tracer is not None:
+            # one trace per request, rooted at submit; span stamps reuse
+            # the scheduler's own clock reads so trace durations and the
+            # TTFT/latency accounting are the same numbers
+            root = self.tracer.begin(
+                "request", trace_id=f"request:{req.request_id}",
+                t0=req.submit_t, request_id=str(req.request_id),
+                prompt_tokens=len(req.tokens))
+            self._req_spans[req] = {
+                "root": root,
+                "queue": self.tracer.begin("queue", parent=root,
+                                           t0=req.submit_t)}
         self.queue.append(req)
 
     def _admit(self) -> None:
@@ -196,11 +229,24 @@ class ServeScheduler:
             publish_event("serve_request_admitted",
                           request_id=req.request_id, slot=slot,
                           queue_wait_s=round(wait, 6))
+            sp = self._req_spans.get(req)
+            if sp is not None:
+                self.tracer.end(sp["queue"], t1=now,
+                                queue_wait_s=round(wait, 6))
+                sp["prefill"] = self.tracer.begin(
+                    "prefill", parent=sp["root"], t0=now, slot=slot)
         first, _last_logits, _all = self.engine.prefill(
             {slot: req.tokens for slot, req in batch.items()})
         t_first = time.perf_counter()
         for slot, req in batch.items():
             req.first_token_t = t_first
+            sp = self._req_spans.get(req)
+            if sp is not None:
+                self.tracer.end(sp["prefill"], t1=t_first)
+                # opened BEFORE _accept_token: a request finishing on its
+                # prefill-sampled token still closes a decode span
+                sp["decode"] = self.tracer.begin(
+                    "decode", parent=sp["root"], t0=t_first, slot=slot)
             self._accept_token(req, int(first[slot]))
 
     # -------------------------------------------------------- lifecycle
@@ -213,12 +259,32 @@ class ServeScheduler:
         elif len(req.tokens) + len(req.generated) >= self.engine.max_len:
             self._finish(req, "context")
 
+    def _close_trace(self, req: Request, marker: str, reason: str) -> None:
+        """End a request's trace: close any still-open lifecycle spans at
+        ``done_t``, drop a terminal marker span, close the root."""
+        sp = self._req_spans.pop(req, None)
+        if sp is None or self.tracer is None:
+            return
+        t1 = req.done_t if req.done_t is not None else time.perf_counter()
+        status = "ok" if marker == "complete" else "cancelled"
+        for key in ("queue", "prefill", "decode"):
+            span = sp.get(key)
+            if span is not None:
+                self.tracer.end(span, t1=t1, status=status)
+        mark = self.tracer.begin(marker, parent=sp["root"], t0=t1,
+                                 reason=reason)
+        self.tracer.end(mark, t1=t1)
+        self.tracer.end(sp["root"], t1=t1, status=status,
+                        finish_reason=reason,
+                        new_tokens=len(req.generated))
+
     def _finish(self, req: Request, reason: str) -> None:
         req.state = "completed"
         req.finish_reason = reason
         req.done_t = time.perf_counter()
         self.done.append(req)
         self._release(req)
+        self._close_trace(req, "complete", reason)
         publish_event("serve_request_completed",
                       request_id=req.request_id, slot=req.slot,
                       new_tokens=len(req.generated), finish_reason=reason,
@@ -263,6 +329,8 @@ class ServeScheduler:
         req.done_t = time.perf_counter()
         self.done.append(req)
         self._release(req)
+        self._close_trace(req, "abort" if reason == "aborted" else "evict",
+                          reason)
         publish_event("serve_request_evicted", level="warning",
                       request_id=req.request_id, slot=req.slot,
                       reason=reason)
@@ -288,6 +356,18 @@ class ServeScheduler:
         self.decode_steps += 1
         self.decode_step_s.append(dt)
         self.decode_tokens += int(active.sum())
+        if self.tracer is not None:
+            if self._sched_span is None:
+                self._sched_span = self.tracer.begin(
+                    "serve", trace_id="serve:scheduler", t0=t0,
+                    num_slots=self.engine.config.num_slots)
+            tick = self.tracer.begin("decode_tick",
+                                     parent=self._sched_span, t0=t0,
+                                     step=self.decode_steps,
+                                     active=int(active.sum()))
+            self.tracer.end(tick, t1=t0 + dt)
+        if self.memory is not None:
+            self.memory.tick("serve_decode", step=self.decode_steps)
         publish_event("serve_decode_step", seconds=dt,
                       active=int(active.sum()))
         for slot, req in enumerate(self.slots):
@@ -298,16 +378,27 @@ class ServeScheduler:
 
     def run(self, max_steps: Optional[int] = None) -> ServeStats:
         """Run until idle (or ``max_steps`` decode steps); returns stats.
-        Unfinished requests are evicted with reason ``shutdown``."""
-        while self.step():
-            if max_steps is not None and self.decode_steps >= max_steps:
-                break
-        for req in list(self.queue) + [r for r in self.slots
-                                       if r is not None]:
-            if req in self.queue:
-                self.queue.remove(req)
-            self._evict(req, "shutdown")
-        self._flush_evictions()
+        Unfinished requests are evicted with reason ``shutdown``. A fatal
+        exception anywhere in the loop leaves a flight-recorder dump
+        (when one is attached) before propagating."""
+        try:
+            with (self.flight.guard("serve") if self.flight is not None
+                  else contextlib.nullcontext()):
+                while self.step():
+                    if max_steps is not None and \
+                            self.decode_steps >= max_steps:
+                        break
+                for req in list(self.queue) + [r for r in self.slots
+                                               if r is not None]:
+                    if req in self.queue:
+                        self.queue.remove(req)
+                    self._evict(req, "shutdown")
+                self._flush_evictions()
+        finally:
+            if self.tracer is not None and self._sched_span is not None:
+                self.tracer.end(self._sched_span,
+                                ticks=self.decode_steps)
+                self._sched_span = None
         return self.stats()
 
     def stats(self) -> ServeStats:

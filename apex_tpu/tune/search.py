@@ -90,6 +90,14 @@ def autotune_kernel(kernel: str, shape: Dict[str, Any], dtype=None, *,
         if best is None or row["ms"] < best["ms"]:
             best = row
 
+    # one allocator sample per kernel sweep (hbm_snapshot on the bus):
+    # tuning is an AOT point — a candidate geometry that balloons HBM
+    # shows up in the run's memory accounting, not just its timing.
+    # Silent off-TPU (CPU backends report no allocator stats).
+    from apex_tpu.monitor.memory import sample_device_memory
+
+    sample_device_memory(f"tune:{kernel}", candidates=len(rows))
+
     result: Dict[str, Any] = {
         "kernel": kernel,
         "shape": dict(shape),

@@ -29,3 +29,43 @@ def interpret_default() -> bool:
     if os.environ.get("APEX_TPU_FORCE_COMPILED") == "1":
         return False
     return not platform_is_tpu()
+
+
+def device_kind() -> str:
+    """The attached device's ``device_kind`` string ("unknown" when no
+    backend is reachable) — the identity check_regression's device gate
+    compares between a capture and its baseline."""
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
+
+
+def git_sha(cwd: str = None) -> str:
+    """Short git sha of ``cwd`` (default: this repo checkout), or
+    "unknown" (wheel installs have no .git)."""
+    import subprocess
+
+    if cwd is None:
+        cwd = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=cwd,
+            capture_output=True, text=True, timeout=10
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def capture_provenance() -> dict:
+    """``device_kind`` / ``interpret_mode`` / git sha / timestamp — the
+    stamp every bench capture carries so ``tools/check_regression.py``
+    can refuse to gate a CPU-smoke/interpret capture against real-chip
+    numbers (one builder; bench.py and apex-tpu-bench both use it)."""
+    import time
+
+    return {"device_kind": device_kind(),
+            "interpret_mode": bool(interpret_default()),
+            "git": git_sha(),
+            "captured": time.strftime("%Y-%m-%dT%H:%M:%S")}

@@ -29,6 +29,11 @@ _seen_warnings: set = set()
 # log pipeline would scrape from stderr — without parsing stderr.
 _event_subscribers: List[Callable[[Dict[str, Any]], None]] = []
 
+# subscribers already reported as raising — the isolation contract is
+# "reported once per subscriber", independent of how many times (or with
+# how many distinct messages) it keeps raising
+_broken_subscribers: set = set()
+
 
 def subscribe_events(callback: Callable[[Dict[str, Any]], None]
                      ) -> Callable[[], None]:
@@ -45,6 +50,10 @@ def subscribe_events(callback: Callable[[Dict[str, Any]], None]
             _event_subscribers.remove(callback)
         except ValueError:
             pass
+        # drop the broken-subscriber mark with the subscription: ids of
+        # gc'd callables get reused, and a later unrelated subscriber at
+        # the same address must not inherit the suppression
+        _broken_subscribers.discard(id(callback))
 
     return _unsubscribe
 
@@ -63,12 +72,19 @@ def publish_event(event: str, *, level: str = "info", stream=None,
     if emit:
         print(json.dumps(rec, sort_keys=True, default=float),
               file=stream or sys.stderr, flush=True)
+    # iterate a snapshot: a subscriber that (un)subscribes during delivery
+    # (a flight recorder detaching itself, a one-shot waiter) must not
+    # perturb this publish's fan-out
     for cb in list(_event_subscribers):
         try:
             cb(rec)
         except Exception as e:  # a broken consumer must not kill training
-            one_time_warning(f"event subscriber {cb!r} raised "
-                             f"{type(e).__name__}: {e}")
+            if id(cb) not in _broken_subscribers:
+                _broken_subscribers.add(id(cb))
+                one_time_warning(
+                    f"event subscriber {cb!r} raised {type(e).__name__}: "
+                    f"{e} (reported once; the event still reaches the "
+                    f"remaining subscribers)")
     return rec
 
 

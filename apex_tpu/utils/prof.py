@@ -20,18 +20,50 @@ from typing import Optional
 import jax
 
 
+# logdir of the live profile() region, if any — jax.profiler raises an
+# opaque internal error on nested start_trace; we fail with context first
+_active_profile: Optional[str] = None
+
+
 @contextlib.contextmanager
 def profile(logdir: str = "/tmp/apex_tpu_trace"):
-    """Capture a device trace for the enclosed region (≈ nsys profile)."""
+    """Capture a device trace for the enclosed region (≈ nsys profile).
+
+    Not reentrant (one device trace per process at a time): a nested call
+    raises ``RuntimeError`` naming the already-active logdir instead of
+    jax's opaque "trace already started" internals.
+    """
+    global _active_profile
+    if _active_profile is not None:
+        raise RuntimeError(
+            f"profile() is not reentrant: a device trace is already being "
+            f"captured to {_active_profile!r} — close it before opening "
+            f"another (use annotate() for nested named ranges)")
     jax.profiler.start_trace(logdir)
+    _active_profile = logdir
     try:
         yield logdir
     finally:
+        _active_profile = None
         jax.profiler.stop_trace()
 
 
-def annotate(name: str):
-    """Named range inside a trace (≈ nvtx.range_push/pop)."""
+def annotate(name: str, **attrs):
+    """Named range inside a trace (≈ nvtx.range_push/pop).
+
+    Always opens a ``jax.profiler.TraceAnnotation`` (visible in the
+    device-trace viewer). When the process span tracer is enabled
+    (:func:`apex_tpu.monitor.trace.set_tracer`, or
+    ``Telemetry(trace_jsonl=...)``), the range ALSO opens a span in the
+    trace tree with ``attrs`` attached — host annotations and the span
+    timeline stay in lockstep because they are the same call.
+    """
+    from apex_tpu.monitor.trace import get_tracer
+
+    tracer = get_tracer()
+    if tracer.enabled:
+        # the tracer's span ctx enters the TraceAnnotation itself
+        return tracer.span(name, **attrs)
     return jax.profiler.TraceAnnotation(name)
 
 
@@ -42,16 +74,48 @@ def annotate_function(fn, name: Optional[str] = None):
 
 class StepTimer:
     """Average/last step timing with device synchronization (the examples'
-    AverageMeter; ``block`` forces completion like cudaDeviceSynchronize)."""
+    AverageMeter; ``block`` forces completion like cudaDeviceSynchronize).
+
+    Context-manager form times the enclosed region::
+
+        timer = StepTimer()
+        with timer:                      # start()/stop() around the body
+            out = step(state)
+            timer.block(out)             # sync on `out` at exit: honest
+                                         # wall clock on an async runtime
+
+    The explicit ``start()``/``stop(block_on=...)`` pair remains for loops
+    that want manual control.
+    """
 
     def __init__(self):
         self.reset()
+
+    def __enter__(self) -> "StepTimer":
+        self.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        block_on, self._block_on = self._block_on, None
+        if exc_type is not None:
+            # aborted step: recording its partial duration would silently
+            # skew avg/total low — drop the window instead
+            self._t0 = None
+            return
+        self.stop(block_on=block_on)
+
+    def block(self, block_on) -> "StepTimer":
+        """Arm the enclosing ``with`` block to ``block_until_ready`` on
+        ``block_on`` when it exits."""
+        self._block_on = block_on
+        return self
 
     def reset(self):
         self.count = 0
         self.total = 0.0
         self.last = 0.0
         self._t0 = None
+        self._block_on = None
 
     def start(self):
         self._t0 = time.perf_counter()

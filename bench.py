@@ -510,16 +510,17 @@ def run_suite(jax, jnp, backend: str, out_path: str | None = None,
     chip = _CHIP.get(gen, _CHIP["v5e"])
     floor_s = measure_fetch_floor()
 
-    try:
-        git = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
-                             cwd=_HERE, capture_output=True, text=True,
-                             timeout=10).stdout.strip()
-    except Exception:
-        git = "unknown"
+    # capture provenance — device_kind/interpret_mode/git/captured from
+    # THE shared builder (apex-tpu-bench --serve stamps identically), so
+    # check_regression compares consistently stamped captures; its
+    # interpret_mode honors APEX_TPU_FORCE_COMPILED, which `not on_tpu`
+    # would misreport
+    from apex_tpu.utils.env import capture_provenance
+
     suite = {"backend": backend, "chip": gen if on_tpu else "cpu-smoke",
+             **capture_provenance(),
              "fetch_floor_ms": round(floor_s * 1e3, 1),
-             "captured": time.strftime("%Y-%m-%dT%H:%M:%S"),
-             "git": git, "complete": False}
+             "complete": False}
 
     def flush():
         if out_path is not None:

@@ -58,6 +58,10 @@ def main():
                          "--sharded-ckpt)")
     ap.add_argument("--telemetry-jsonl", type=str, default=None,
                     help="emit per-step telemetry rows to this JSONL file")
+    ap.add_argument("--trace-jsonl", type=str, default=None,
+                    help="export per-step span traces as Perfetto-"
+                         "loadable Chrome-trace JSON (with "
+                         "--telemetry-jsonl)")
     args = ap.parse_args()
 
     from apex_tpu.models.gpt2 import GPT2, GPT2Config
@@ -103,10 +107,11 @@ def main():
         return loss, grads, tm
 
     telemetry = None
-    if args.telemetry_jsonl:
+    if args.telemetry_jsonl or args.trace_jsonl:
         from apex_tpu.monitor import Telemetry
         telemetry = Telemetry(args.telemetry_jsonl,
-                              tokens_per_step=args.batch * args.seq)
+                              tokens_per_step=args.batch * args.seq,
+                              trace_jsonl=args.trace_jsonl)
         telemetry.calibrate(grads_of, params)
 
     # optional resilience: resumable atomic checkpoints + preemption guard.
@@ -155,9 +160,20 @@ def main():
     try:
         if telemetry is not None:
             telemetry.start()
+        import contextlib
+
+        def span(name):
+            # per-step spans only when --trace-jsonl enabled a tracer:
+            # each span also lands one mirrored JSONL event, and plain
+            # telemetry must keep its events low-rate
+            if telemetry is not None and telemetry.tracer is not None:
+                return telemetry.span(name)
+            return contextlib.nullcontext()
+
         for step in range(start_step, args.steps):
-            loss, grads, tm = grads_of(params)
-            params = opt.step(grads)
+            with span("train_step"):
+                loss, grads, tm = grads_of(params)
+                params = opt.step(grads)
             if telemetry is not None:
                 # the float(loss) print below is the loop's host sync; the
                 # logged metric values stay device arrays until flush
@@ -166,7 +182,8 @@ def main():
                 l0 = float(loss)
             print(f"step {step}: loss {float(loss):.4f}", flush=True)
             if manager is not None and step % args.save_every == 0:
-                save(step, params)  # save stalls land in the goodput ledger
+                with span("checkpoint"):  # the trace's ckpt-stall leg
+                    save(step, params)  # stalls land in the goodput ledger
             if guard is not None and guard.should_stop():
                 save(step, params)  # final synchronous save, then stop
                 if rank0:

@@ -240,6 +240,114 @@ def test_serve_events_registered():
     assert s["events"]["serve_decode_step"] == 1
 
 
+def test_repo_wide_event_schema_audit():
+    """EVERY literal ``publish_event``/``structured_warning`` call site in
+    the package must use a name registered in the goodput/event schema
+    (STALL | COUNTED | INFO) — the repo-wide generalization of the
+    serve-only grep above, so a new subsystem cannot ship an event no
+    monitoring consumer knows about."""
+    import re
+
+    import apex_tpu
+    from apex_tpu.monitor.goodput import EVENT_SCHEMA
+
+    pattern = re.compile(
+        r'(?:publish_event|structured_warning)\(\s*["\']([a-z_0-9]+)["\']')
+    sites = []           # (relpath, event_name) per literal call site
+    pkg_dir = os.path.dirname(apex_tpu.__file__)
+    for dirpath, dirnames, filenames in os.walk(pkg_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                for name in pattern.findall(f.read()):
+                    sites.append((os.path.relpath(path, pkg_dir), name))
+    # sanity: the regex still matches the real call sites (the seed had
+    # 20 across 10 files; this PR added trace/memory/flight publishers)
+    assert len(sites) >= 25, sites
+    assert len({p for p, _ in sites}) >= 10
+    unregistered = {name for _, name in sites} - EVENT_SCHEMA
+    assert not unregistered, \
+        f"events missing from the monitor.goodput schema: {unregistered}"
+
+
+def test_raising_subscriber_isolated_once(capsys):
+    """The subscribe_events docstring contract: a raising subscriber is
+    reported exactly once (even raising DIFFERENT exceptions each time)
+    and every event still reaches the remaining subscribers."""
+    calls = []
+    n = [0]
+
+    def bad(rec):
+        n[0] += 1
+        raise ValueError(f"boom {n[0]}")   # distinct message per raise
+
+    def good(rec):
+        calls.append(rec["event"])
+
+    unsub_bad = subscribe_events(bad)
+    unsub_good = subscribe_events(good)
+    try:
+        for _ in range(3):
+            publish_event("span", name="x")
+    finally:
+        unsub_bad()
+        unsub_good()
+    assert calls == ["span"] * 3           # delivery survived the raiser
+    assert capsys.readouterr().err.count("raised ValueError") == 1
+
+
+def test_unsubscribe_during_publish_is_safe():
+    seen = []
+    unsubs = {}
+
+    def s1(rec):
+        seen.append("s1")
+        unsubs["s2"]()                     # removes s2 mid-delivery
+
+    def s2(rec):
+        seen.append("s2")
+
+    unsubs["s1"] = subscribe_events(s1)
+    unsubs["s2"] = subscribe_events(s2)
+    try:
+        # snapshot semantics: s2 still sees THIS publish...
+        publish_event("span", name="a")
+        # ...and is gone for the next one
+        publish_event("span", name="b")
+    finally:
+        unsubs["s1"]()
+        unsubs["s2"]()                     # idempotent second call
+    assert seen == ["s1", "s2", "s1"]
+
+
+def test_telemetry_trace_jsonl_exports_chrome_trace(tmp_path):
+    """Telemetry(trace_jsonl=...) enables the process tracer for the run,
+    streams completed spans as Perfetto-loadable Chrome-trace JSON, keeps
+    the high-rate span_open/span_close records OUT of the metric JSONL
+    mirror, and restores the previous tracer on close."""
+    from apex_tpu.monitor import read_chrome_trace
+    from apex_tpu.monitor.trace import get_tracer
+
+    path = str(tmp_path / "run.jsonl")
+    tpath = str(tmp_path / "trace.json")
+    prev = get_tracer()
+    tel = Telemetry(path, trace_jsonl=tpath)
+    assert get_tracer() is tel.tracer and tel.tracer.enabled
+    with tel.span("checkpoint"):
+        pass
+    tel.close()
+    assert get_tracer() is prev
+    xs = [e for e in read_chrome_trace(tpath) if e.get("ph") == "X"]
+    assert [e["name"] for e in xs] == ["checkpoint"]
+    _, events = read_jsonl(path)
+    names = [e["event"] for e in events]
+    assert "span" in names                        # the legacy aggregate
+    assert "span_open" not in names and "span_close" not in names
+
+
 def test_checkpoint_save_publishes_stall_event(tmp_path):
     # call-time imports for BOTH sides: test_chip_worker's module purge can
     # leave collection-time and re-imported apex_tpu identities coexisting,
@@ -422,6 +530,61 @@ def test_telemetry_flush_every_bounds_buffer(tmp_path):
     assert len(rows) == 5
 
 
+def test_check_regression_device_kind_mismatch(tmp_path):
+    """Capture provenance satellite: a CPU-smoke capture gating a TPU
+    baseline warns LOUDLY, and --fail-device-mismatch makes it exit 1
+    even when every metric is within tolerance."""
+    entry = {"metric": "a_ms", "value": 10.0, "unit": "ms"}
+    base = {"device_kind": "TPU v5e", "interpret_mode": False,
+            "bench_a": entry}
+    cur = {"device_kind": "TPU v3 (cpu-smoke)", "interpret_mode": True,
+           "bench_a": entry}
+    basep, curp = str(tmp_path / "b.json"), str(tmp_path / "c.json")
+    with open(basep, "w") as f:
+        json.dump(base, f)
+    with open(curp, "w") as f:
+        json.dump(cur, f)
+    r = _gate(curp, basep)
+    assert r.returncode == 0               # warn-only by default
+    assert "device-kind mismatch" in r.stderr
+    r = _gate(curp, basep, "--fail-device-mismatch")
+    assert r.returncode == 1
+    # same kinds: silent, flag or not
+    r = _gate(basep, basep, "--fail-device-mismatch")
+    assert r.returncode == 0 and "mismatch" not in r.stderr
+    # legacy captures without the stamps keep gating without noise
+    legacy = {"bench_a": entry}
+    with open(curp, "w") as f:
+        json.dump(legacy, f)
+    r = _gate(curp, basep, "--fail-device-mismatch")
+    assert r.returncode == 0 and "mismatch" not in r.stderr
+    # vocabularies never mix: a new capture (device_kind "cpu" + chip
+    # "cpu-smoke") against the committed legacy baseline (chip only)
+    # compares chip-vs-chip — identical hardware must NOT flag...
+    with open(basep, "w") as f:
+        json.dump({"chip": "cpu-smoke", "bench_a": entry}, f)
+    with open(curp, "w") as f:
+        json.dump({"device_kind": "cpu", "chip": "cpu-smoke",
+                   "bench_a": entry}, f)
+    r = _gate(curp, basep, "--fail-device-mismatch")
+    assert r.returncode == 0 and "mismatch" not in r.stderr
+    # ...while a REAL chip difference still does
+    with open(basep, "w") as f:
+        json.dump({"chip": "v5e", "bench_a": entry}, f)
+    r = _gate(curp, basep, "--fail-device-mismatch")
+    assert r.returncode == 1 and "device-kind mismatch" in r.stderr
+    # same chip but interpret-mode capture vs compiled baseline: still
+    # not comparable (interpret Pallas on a TPU host != the real chip)
+    with open(basep, "w") as f:
+        json.dump({"device_kind": "TPU v5e", "interpret_mode": False,
+                   "bench_a": entry}, f)
+    with open(curp, "w") as f:
+        json.dump({"device_kind": "TPU v5e", "interpret_mode": True,
+                   "bench_a": entry}, f)
+    r = _gate(curp, basep, "--fail-device-mismatch")
+    assert r.returncode == 1 and "interpret_mode" in r.stderr
+
+
 def test_check_regression_suite_baseline(tmp_path):
     suite = {"backend": "cpu", "complete": True,
              "bench_a": {"metric": "a_ms", "value": 10.0, "unit": "ms",
@@ -456,27 +619,82 @@ def _run_cli(args, timeout=600):
 def test_bench_cli_telemetry_smoke(tmp_path):
     """Tier-1 gate: ``apex-tpu-bench --telemetry-jsonl`` runs a few steps
     on CPU and every emitted row validates against the schema with the
-    acceptance keys present."""
+    acceptance keys present. ``--trace-jsonl`` on the same run exports a
+    Perfetto-loadable Chrome trace with one train_step trace per step
+    and captures the calibrated step's static memory reservation."""
     path = str(tmp_path / "bench.jsonl")
+    tpath = str(tmp_path / "bench_trace.json")
     # pre-seed the file with a stale row: a per-run sink must truncate, or
     # mixed-run medians would skew the regression gate; the '=' flag form
     # must be recognized too
     with open(path, "w") as f:
         f.write(json.dumps({"step": 99, "stale": True}) + "\n")
-    r = _run_cli([f"--telemetry-jsonl={path}", "--steps", "4"])
+    r = _run_cli([f"--telemetry-jsonl={path}", f"--trace-jsonl={tpath}",
+                  "--steps", "4"])
     assert r.returncode == 0, r.stderr[-2000:]
     headline = json.loads(r.stdout.strip().splitlines()[-1])
     assert headline["metric"] == "telemetry_train_step_ms_lm_tiny"
     assert headline["value"] > 0
     assert headline["goodput"] == pytest.approx(1.0)
 
-    rows, _events = read_jsonl(path)
+    rows, events = read_jsonl(path)
     assert len(rows) == 4  # the stale pre-run row was truncated away
     for row in rows:
         validate_row(row, require=PERF_ROW_KEYS)
         assert row["step_ms"] > 0
         assert row["tokens_per_s"] > 0
         assert row["loss_scale"] == 2.0 ** 12
+    # calibrate's AOT point published its static memory reservation
+    assert any(e["event"] == "hbm_snapshot" and e.get("kind") == "static"
+               for e in events)
+
+    from apex_tpu.monitor.trace import read_chrome_trace
+
+    xs = [e for e in read_chrome_trace(tpath) if e.get("ph") == "X"]
+    assert [e["name"] for e in xs] == ["train_step"] * 4
+    # per-step spans line up with the logged rows (same wall clock)
+    durs_ms = sorted(e["dur"] / 1e3 for e in xs)
+    assert durs_ms[0] > 0
+
+
+def test_bench_fatal_step_leaves_flight_dump(tmp_path, monkeypatch):
+    """A fatal exception inside the telemetry bench's step loop has no
+    bus record — the armed flight recorder's guard must still dump, and
+    teardown must restore the process tracer and terminate the Chrome
+    trace (in-process; a subprocess would only burn budget)."""
+    import apex_tpu.bench_cli as bc
+    from apex_tpu.monitor.trace import get_tracer, read_chrome_trace
+
+    real = bc._make_telemetry_step
+
+    def exploding():
+        step, state, tokens, tps = real()
+        calls = [0]
+
+        def bad_step(i, st, tk):
+            calls[0] += 1
+            if calls[0] >= 3:       # past calibrate + warmup: mid-loop
+                raise RuntimeError("xla died")
+            return step(i, st, tk)
+
+        bad_step.lower = step.lower     # calibrate path stays intact
+        return bad_step, state, tokens, tps
+
+    monkeypatch.setattr(bc, "_make_telemetry_step", exploding)
+    fpath = str(tmp_path / "f.json")
+    tpath = str(tmp_path / "t.json")
+    with pytest.raises(RuntimeError, match="xla died"):
+        bc._telemetry_bench(None, steps=10, trace_jsonl=tpath,
+                            flight_path=fpath)
+    d = json.loads(open(fpath).read())
+    assert d["reason"] == "exception:RuntimeError:telemetry_bench"
+    assert get_tracer() is not None and not get_tracer().enabled
+    read_chrome_trace(tpath)            # terminated, parseable
+    # the recorder unsubscribed: later events don't touch the dump
+    mtime = os.path.getmtime(fpath)
+    from apex_tpu.utils.logging import publish_event
+    publish_event("preemption_requested", level="warning")
+    assert os.path.getmtime(fpath) == mtime
 
 
 def test_bench_cli_step_is_single_jitted_call():

@@ -290,6 +290,91 @@ def test_top_k_restricts_to_top_k(params, keeper3):
         assert int(first[0]) in top5
 
 
+# ------------------------------------------------------------ tracing
+
+def test_request_traces_reconcile_with_stats(greedy2):
+    """THE tracing acceptance: every completed request is exactly one
+    trace with queue/prefill/decode/complete spans whose durations equal
+    the scheduler's own TTFT/latency accounting (same clock reads), the
+    scheduler trace carries one decode_tick per step, and tracing adds
+    ZERO compiles (the one-jit invariant holds with it on)."""
+    from apex_tpu.monitor import Tracer, spans_by_trace
+
+    eng = greedy2.reset()
+    tracer = Tracer()
+    sched = ServeScheduler(eng, tracer=tracer)
+    for i in range(4):
+        sched.submit(Request(request_id=f"r{i}",
+                             tokens=_tokens(5, seed=i), max_new_tokens=4))
+    stats = sched.run()
+    assert eng.decode_traces == 1          # tracing retraced nothing
+    by_trace = spans_by_trace(tracer.completed_records())
+    recs = {r["request_id"]: r for r in stats.requests}
+    assert len(recs) == 4
+    tol = 2e-3  # span stamps round to the microsecond; ttft to 1e-6 s
+    for rid, rec in recs.items():
+        spans = {s["name"]: s for s in by_trace[f"request:{rid}"]}
+        assert set(spans) == {"request", "queue", "prefill", "decode",
+                              "complete"}, rid
+        q, p, d = spans["queue"], spans["prefill"], spans["decode"]
+        root = spans["request"]
+        assert abs((q["t1"] - q["t0"]) + (p["t1"] - p["t0"])
+                   - rec["ttft_s"]) < tol
+        assert abs((root["t1"] - root["t0"]) - rec["latency_s"]) < tol
+        assert abs((d["t1"] - d["t0"])
+                   - (rec["latency_s"] - rec["ttft_s"])) < tol
+        assert root["attrs"]["new_tokens"] == rec["new_tokens"]
+        for s in spans.values():
+            assert s["status"] == "ok"
+    ticks = [s for s in by_trace["serve:scheduler"]
+             if s["name"] == "decode_tick"]
+    assert len(ticks) == stats.decode_steps
+    assert not tracer.open_spans()         # run() closed everything
+
+
+@pytest.mark.fault
+def test_aborted_request_trace_marks_abort(greedy2):
+    from apex_tpu.monitor import Tracer, spans_by_trace
+
+    tracer = Tracer()
+    inj = FaultInjector(seed=0).abort_request("r1", at_step=2)
+    sched = ServeScheduler(greedy2.reset(), fault_injector=inj,
+                           tracer=tracer)
+    for i in range(2):
+        sched.submit(Request(request_id=f"r{i}",
+                             tokens=_tokens(5, seed=i), max_new_tokens=6))
+    sched.run()
+    spans = {s["name"]: s for s in spans_by_trace(
+        tracer.completed_records())["request:r1"]}
+    assert "abort" in spans and "complete" not in spans
+    assert spans["request"]["status"] == "cancelled"
+    assert spans["request"]["attrs"]["finish_reason"] == "aborted"
+    # the surviving request completed normally
+    other = spans_by_trace(tracer.completed_records())["request:r0"]
+    assert {s["name"] for s in other} >= {"request", "complete"}
+
+
+def test_untraced_scheduler_publishes_no_spans(greedy3):
+    """Tracing disabled (the default) adds nothing: no span records on
+    the bus, no per-request bookkeeping, and — asserted everywhere else
+    in this file — no extra compiles."""
+    from apex_tpu.utils.logging import subscribe_events
+
+    seen = []
+    unsub = subscribe_events(
+        lambda r: seen.append(r) if str(r.get("event", "")).startswith(
+            "span_") else None)
+    try:
+        sched = ServeScheduler(greedy3.reset())
+        sched.submit(Request(request_id=0, tokens=_tokens(5),
+                             max_new_tokens=2))
+        sched.run()
+    finally:
+        unsub()
+    assert not seen
+    assert sched.tracer is None and not sched._req_spans
+
+
 # -------------------------------------------------- scheduler / events
 
 def test_backfill_and_queue_wait_accounting(greedy2):
@@ -371,12 +456,17 @@ def _cli_env():
     return env
 
 
-def test_serve_cli_smoke():
+def test_serve_cli_smoke(tmp_path):
+    """The scripted-serve acceptance: one CLI run with --trace-jsonl
+    yields a Perfetto-loadable trace where every completed request has
+    exactly one trace with queue/prefill/decode/complete spans — and the
+    run still compiles decode exactly once."""
+    tpath = str(tmp_path / "serve_trace.json")
     r = subprocess.run(
         [sys.executable, "-m", "apex_tpu.serve.cli", "--config", "tiny",
          "--requests", "3", "--prompt-len", "4", "--max-new-tokens", "4",
          "--num-slots", "2", "--max-len", "32", "--temperature", "0",
-         "--aot"],
+         "--aot", "--trace-jsonl", tpath],
         cwd=ROOT, env=_cli_env(), capture_output=True, text=True,
         timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
@@ -386,6 +476,26 @@ def test_serve_cli_smoke():
     assert all(rec["state"] == "completed" for rec in recs)
     assert summary["decode_compiles"] == 1
     assert summary["summary"]["new_tokens"] == 12
+
+    from apex_tpu.monitor.trace import read_chrome_trace
+
+    events = read_chrome_trace(tpath)           # strict JSON when closed
+    xs = [e for e in events if e.get("ph") == "X"]
+    per_trace = {}
+    for e in xs:
+        per_trace.setdefault(e["args"]["trace_id"], set()).add(e["name"])
+    for rec in recs:
+        spans = per_trace[f"request:{rec['request_id']}"]
+        assert spans == {"request", "queue", "prefill", "decode",
+                         "complete"}, rec["request_id"]
+        # durations reconcile with the CLI's own accounting (±1 tick)
+        root = next(e for e in xs
+                    if e["args"]["trace_id"]
+                    == f"request:{rec['request_id']}"
+                    and e["name"] == "request")
+        tick_ms = summary["summary"]["p99_step_ms"] + 1.0
+        assert abs(root["dur"] / 1e3 - rec["latency_s"] * 1e3) <= tick_ms
+    assert "serve:scheduler" in per_trace       # the tick track
 
 
 @pytest.mark.slow
@@ -426,6 +536,11 @@ def test_bench_serve_smoke_and_regression_gate(tmp_path, capsys):
     assert entry["value"] > 0 and entry["unit"] == "tokens_per_s"
     for k in ("p50_ms", "p99_ms", "ttft_ms"):
         assert entry[k] >= 0
+    # capture provenance is stamped (device-kind gate satellite): on this
+    # CPU harness the capture must say so
+    for k in ("device_kind", "interpret_mode", "git", "captured"):
+        assert k in suite, k
+    assert suite["interpret_mode"] is True
 
     base = dict(suite)
     path_cur = tmp_path / "cur.json"

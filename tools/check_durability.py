@@ -11,9 +11,12 @@ stage to ``.tmp`` + publish with ``os.replace``, or route through the
 or write only to an in-memory buffer.
 
 Scope (kept deliberately narrow to stay false-positive-free):
-- files whose path contains ``checkpoint``, and
-- functions whose name contains save/checkpoint/ckpt/manifest anywhere in
-  ``apex_tpu/``.
+- files whose path contains ``checkpoint``,
+- the flight recorder (``monitor/flight``) — its crash-time postmortem
+  dump is exactly the artifact a torn write would make worthless, so it
+  follows the same ``.tmp`` + ``os.replace`` rule, and
+- functions whose name contains save/checkpoint/ckpt/manifest/dump
+  anywhere in ``apex_tpu/``.
 
 Sharded-checkpoint paths (``resilience/distributed``) get two stricter
 rules on top — the two-phase commit's whole crash-safety argument rests on
@@ -40,7 +43,7 @@ from typing import List, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(ROOT, "apex_tpu")
 
-CKPT_NAME_HINTS = ("save", "checkpoint", "ckpt", "manifest")
+CKPT_NAME_HINTS = ("save", "checkpoint", "ckpt", "manifest", "dump")
 WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb")
 # evidence of the atomic-commit discipline inside a function's source
 SAFE_MARKERS = (".tmp", "os.replace")
@@ -50,6 +53,8 @@ ALLOWED_FUNCS = {"write_bytes"}  # the seam's own implementation
 
 # sharded-checkpoint modules: the stricter ruleset applies
 SHARDED_PATH_HINTS = (os.path.join("resilience", "distributed"),)
+# flight-recorder module: every on-disk dump is a durable artifact
+FLIGHT_PATH_HINTS = (os.path.join("monitor", "flight"),)
 # evidence a sharded write targets the .tmp staging dir
 STAGING_MARKERS = (".tmp", "_TMP_SUFFIX")
 # non-atomic publish calls: (module attr, call name)
@@ -128,6 +133,7 @@ def _check_file(path: str) -> List[Tuple[int, str]]:
     norm = os.path.normpath(path).lower()
     ckpt_file = "checkpoint" in os.path.basename(path).lower()
     sharded_file = any(h in norm for h in SHARDED_PATH_HINTS)
+    flight_file = any(h in norm for h in FLIGHT_PATH_HINTS)
     lines = src.splitlines()
     violations: List[Tuple[int, str]] = []
 
@@ -148,7 +154,7 @@ def _check_file(path: str) -> List[Tuple[int, str]]:
             seg = ("\n".join(lines[fn.lineno - 1:fn.end_lineno])
                    if fn is not None else src)
             if _is_write_call(node):
-                in_scope = ckpt_file or sharded_file or any(
+                in_scope = ckpt_file or sharded_file or flight_file or any(
                     h in name.lower() for h in CKPT_NAME_HINTS)
                 if in_scope and name not in ALLOWED_FUNCS:
                     safe = (all(m in seg for m in SAFE_MARKERS)
@@ -156,9 +162,9 @@ def _check_file(path: str) -> List[Tuple[int, str]]:
                     if not safe:
                         violations.append((
                             node.lineno,
-                            f"{name}: non-atomic write on a checkpoint "
-                            f"path (want .tmp + os.replace, or the "
-                            f"Filesystem.write_bytes seam)"))
+                            f"{name}: non-atomic write on a durable-"
+                            f"artifact path (want .tmp + os.replace, or "
+                            f"the Filesystem.write_bytes seam)"))
             if sharded_file and (_is_seam_write(node) or (
                     _is_write_call(node) and _writes_to_path(node))):
                 # sharded rule 1: every write — seam included — must show

@@ -36,8 +36,15 @@ regresses when it is worse than baseline by more than ``--tolerance``
 (relative). Harness-noise fields (``bench_wall_s``, ``t``, wall stamps)
 are excluded.
 
-Exit status: 0 all compared metrics within tolerance, 1 any regression,
-2 usage error / nothing comparable.
+Suite captures carry provenance stamps (``device_kind``,
+``interpret_mode``, ``git``, ``captured`` — ``apex-tpu-bench`` writes
+them): when capture and baseline device kinds differ, the gate prints a
+LOUD warning (a CPU-smoke capture must not gate TPU numbers), and
+``--fail-device-mismatch`` makes it exit 1.
+
+Exit status: 0 all compared metrics within tolerance, 1 any regression
+(or a device mismatch under ``--fail-device-mismatch``), 2 usage error /
+nothing comparable.
 """
 
 from __future__ import annotations
@@ -143,6 +150,75 @@ def load_metrics(path: str, warmup: int) -> Dict[str, Tuple[float, Optional[str]
     return metrics_from_jsonl(lines, warmup)
 
 
+def capture_provenance(path: str) -> Dict[str, object]:
+    """Best-effort provenance fields from a suite-format capture
+    (``device_kind``, ``interpret_mode``, ``chip``, ``backend``, ``git``).
+    Telemetry JSONLs and old baselines without the stamps return ``{}``."""
+    try:
+        with open(path) as f:
+            doc = json.loads(f.read())
+    except (ValueError, OSError):
+        return {}
+    if not isinstance(doc, dict):
+        return {}
+    return {k: doc[k] for k in ("device_kind", "interpret_mode", "chip",
+                                "backend", "git", "captured")
+            if k in doc}
+
+
+def device_kinds(cur_prov: Dict[str, object],
+                 base_prov: Dict[str, object]
+                 ) -> Tuple[Optional[str], Optional[str]]:
+    """The comparable device identities of the two captures.
+
+    Compared like-for-like: the stamped ``device_kind`` when BOTH sides
+    carry it, else the legacy ``chip`` field when both carry that
+    (``cpu-smoke`` vs a TPU generation). Mixing vocabularies — a new
+    capture's ``device_kind: "cpu"`` against a legacy baseline's ``chip:
+    "cpu-smoke"`` — would flag identical hardware, so a key present on
+    only one side is never compared against the other key."""
+    for key in ("device_kind", "chip"):
+        cur, base = cur_prov.get(key), base_prov.get(key)
+        if cur is not None and base is not None:
+            return str(cur), str(base)
+    return None, None
+
+
+def check_device_kinds(current_path: str, baseline_path: str,
+                       fail_on_mismatch: bool) -> bool:
+    """Warn LOUDLY (optionally fail) when capture and baseline come from
+    different device kinds OR interpret modes — a CPU-smoke/interpret
+    capture gating as if it were real-chip numbers (or vice versa) is the
+    standing confusion this ends. Returns True when the mismatch should
+    fail the gate."""
+    cur_prov = capture_provenance(current_path)
+    base_prov = capture_provenance(baseline_path)
+    cur, base = device_kinds(cur_prov, base_prov)
+    mismatch = None
+    if cur is not None and base is not None and cur != base:
+        mismatch = f"current capture is {cur!r}, baseline is {base!r}"
+    else:
+        # same chip is not enough: interpret-mode Pallas numbers on a TPU
+        # host are still not real-chip numbers
+        cur_im = cur_prov.get("interpret_mode")
+        base_im = base_prov.get("interpret_mode")
+        if cur_im is not None and base_im is not None \
+                and bool(cur_im) != bool(base_im):
+            mismatch = (f"current capture interpret_mode={bool(cur_im)}, "
+                        f"baseline interpret_mode={bool(base_im)}")
+    if mismatch is None:
+        return False
+    print("=" * 72, file=sys.stderr)
+    print(f"WARNING: device-kind mismatch — {mismatch}.\n"
+          f"These numbers are NOT comparable: an interpret-mode/CPU-smoke "
+          f"capture must not gate real-chip numbers (or vice versa). "
+          f"Re-capture on the baseline's device kind, or refresh the "
+          f"baseline. Pass --fail-device-mismatch to make this fatal.",
+          file=sys.stderr)
+    print("=" * 72, file=sys.stderr)
+    return fail_on_mismatch
+
+
 def compare(current: Dict[str, Tuple[float, Optional[str]]],
             baseline: Dict[str, Tuple[float, Optional[str]]],
             tolerance: float, only: Optional[List[str]] = None) -> Tuple[List[dict], List[str]]:
@@ -213,6 +289,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="leading JSONL rows to drop (compile step)")
     ap.add_argument("--metric", action="append", default=None,
                     help="restrict the comparison to these metric names")
+    ap.add_argument("--fail-device-mismatch", action="store_true",
+                    help="exit 1 when capture and baseline device_kind "
+                         "differ (default: loud warning only)")
     args = ap.parse_args(argv)
 
     if (args.baseline is None) == (args.suite is None):
@@ -232,6 +311,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"check_regression: unparseable input: {e}", file=sys.stderr)
         return 2
+
+    device_fail = check_device_kinds(args.current, baseline_path,
+                                     args.fail_device_mismatch)
 
     if args.kernels:
         names = [k.strip() for k in args.kernels.split(",") if k.strip()]
@@ -265,6 +347,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("check_regression: nothing comparable between the two "
               "captures", file=sys.stderr)
         return 2
+    if device_fail:
+        print("check_regression: failing on device-kind mismatch "
+              "(--fail-device-mismatch)", file=sys.stderr)
+        return 1
     return 1 if regressions else 0
 
 
