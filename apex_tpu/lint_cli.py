@@ -1,0 +1,40 @@
+"""``apex-tpu-lint`` console-script shim.
+
+The linter itself lives in ``tools/apexlint`` (it is a repo-development
+tool — it ships with the source tree, not inside the library package, so
+the library never imports its own linter). This shim locates the repo
+root relative to the installed/source-tree ``apex_tpu`` package and
+dispatches to :func:`tools.apexlint.cli.main`; a pip-installed wheel
+without the source tree gets a clear error instead of a stack trace.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+
+def _repo_root() -> Optional[str]:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    probe = os.path.join(root, "tools", "apexlint", "cli.py")
+    return root if os.path.exists(probe) else None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    root = _repo_root()
+    if root is None:
+        print("apex-tpu-lint: tools/apexlint not found next to the "
+              "apex_tpu package — the linter runs from a source checkout "
+              "(python -m tools.apexlint from the repo root)",
+              file=sys.stderr)
+        return 2
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.apexlint.cli import main as lint_main
+
+    return lint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,7 @@ keeps them:
 
 ``dump()`` writes one JSON artifact with the same ``.tmp`` +
 ``os.replace`` atomicity as every other on-disk artifact in the repo
-(``tools/check_durability.py`` lints it): a dump torn by the very crash
+(``apex-tpu-lint`` rule APX004 lints it): a dump torn by the very crash
 it documents would be worse than none. Auto-dump triggers, zero wiring
 beyond ``attach()`` — the trigger records already ride the bus:
 
@@ -107,6 +107,14 @@ class FlightRecorder:
         self.dumps = 0
         self.last_hbm: Optional[Dict[str, Any]] = None
         self._lock = threading.Lock()
+        # dump() can run concurrently: an auto-dump fires on whatever
+        # thread published the trigger (watchdog heartbeat) while a
+        # guard() dump runs on the crashing thread — both target the same
+        # ``.tmp`` staging path, and interleaved writes would tear the
+        # "atomic" artifact. A dedicated lock (not ``_lock``: snapshot()
+        # holds that, and ring appends must not stall behind file I/O)
+        # serializes whole dumps; the last writer leaves a complete file.
+        self._dump_lock = threading.Lock()
         self._unsubscribe = None
 
     # ---- bus wiring ----------------------------------------------------
@@ -163,12 +171,20 @@ class FlightRecorder:
         """Write the postmortem atomically (stage to ``.tmp``, publish
         with one ``os.replace`` — a crash mid-dump leaves the previous
         complete dump, never a torn one). Returns the path."""
-        payload = self.snapshot(reason)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f, indent=1, sort_keys=True, default=str)
-        os.replace(tmp, self.path)
-        self.dumps += 1
+        with self._dump_lock:
+            # snapshot INSIDE the lock: were it taken before, a stale
+            # snapshot could win the write race and the surviving
+            # postmortem would miss the very events (the fatal exception)
+            # that triggered the later dump. snapshot() only holds _lock
+            # for the in-memory copy, so ring appends still never stall
+            # behind this file write.
+            payload = self.snapshot(reason)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True,
+                          default=str)
+            os.replace(tmp, self.path)
+            self.dumps += 1
         publish_event("flight_recorder_dump", emit=False, path=self.path,
                       reason=reason, events=len(payload["events"]),
                       open_spans=len(payload["open_spans"]))

@@ -286,6 +286,7 @@ class ChromeTraceWriter:
         self._wrote_any = True
 
     def _tid(self, trace_id: str) -> int:
+        # caller holds self._lock (write_span)
         tid = self._tids.get(trace_id)
         if tid is None:
             tid = self._tids[trace_id] = len(self._tids) + 1

@@ -393,7 +393,10 @@ class CollectiveWatchdog:
                     escalate=self.escalate,
                     rank=(self.coordinator.process_index
                           if self.coordinator is not None else 0))
-                self.stalls.append(rec)
+                # under the lock: appended here on the heartbeat thread,
+                # read from the owning thread (tests, run reports)
+                with self._lock:
+                    self.stalls.append(rec)
                 if self.escalate in ("dump", "abort"):
                     self._dump_stacks(reg["name"])
                 if self.escalate == "abort":
@@ -410,12 +413,14 @@ class CollectiveWatchdog:
         stream = stream or sys.stderr
         try:
             stacks = thread_stacks()
-            print(f"collective_stall[{name}]: dumping "
+            # not rank-0-gated on purpose: the straggler's own stacks are
+            # the diagnostic, and only the stuck host can print them
+            print(f"collective_stall[{name}]: dumping "  # apexlint: disable=APX005 -- every-rank postmortem: the stuck host must dump its own stacks
                   f"{len(stacks)} thread stacks", file=stream)
             for label, frames in stacks.items():
-                print(f"--- thread {label} ---", file=stream)
+                print(f"--- thread {label} ---", file=stream)  # apexlint: disable=APX005 -- every-rank postmortem: the stuck host must dump its own stacks
                 for line in frames:
-                    print(line, file=stream)
+                    print(line, file=stream)  # apexlint: disable=APX005 -- every-rank postmortem: the stuck host must dump its own stacks
             stream.flush()
         except Exception:
             pass  # diagnostics must never take down the watchdog thread

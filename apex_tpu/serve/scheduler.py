@@ -49,6 +49,7 @@ from __future__ import annotations
 import collections
 import contextlib
 import dataclasses
+import threading
 import time
 from typing import Any, Deque, Dict, List, Optional, Sequence
 
@@ -157,7 +158,10 @@ class ServeStats:
 class ServeScheduler:
     """Drive an :class:`Engine` over a request stream with continuous
     batching. ``fault_injector`` (optional) supplies scripted mid-stream
-    aborts; a real deployment calls :meth:`abort` directly."""
+    aborts; a real deployment calls :meth:`abort` directly —
+    :meth:`submit` and :meth:`abort` are safe from other threads while
+    :meth:`run` drives the loop (one reentrant lock serializes every
+    queue/slot mutation; a cross-thread call lands between ticks)."""
 
     def __init__(self, engine: Engine, *, fault_injector=None,
                  tracer=None, flight_recorder=None, memory_accountant=None):
@@ -170,6 +174,12 @@ class ServeScheduler:
         self.memory = memory_accountant
         self._req_spans: Dict[Request, Dict[str, Any]] = {}
         self._sched_span = None    # root of the scheduler's tick trace
+        # submit()/abort() are documented entry points for OTHER threads
+        # (a serving frontend feeding the loop, a deployment cancelling a
+        # request) while step() runs — every queue/slot/accounting
+        # mutation takes this lock (apexlint APX002 keeps the
+        # discipline). Reentrant: step()'s injector path calls abort().
+        self._lock = threading.RLock()
         self.queue: Deque[Request] = collections.deque()
         self.slots: List[Optional[Request]] = \
             [None] * engine.config.num_slots
@@ -191,24 +201,26 @@ class ServeScheduler:
                 f"{self.engine.max_len}")
         req.submit_t = time.perf_counter()
         req.state = "queued"
-        if self.tracer is not None:
-            # one trace per request, rooted at submit; span stamps reuse
-            # the scheduler's own clock reads so trace durations and the
-            # TTFT/latency accounting are the same numbers
-            root = self.tracer.begin(
-                "request", trace_id=f"request:{req.request_id}",
-                t0=req.submit_t, request_id=str(req.request_id),
-                prompt_tokens=len(req.tokens))
-            self._req_spans[req] = {
-                "root": root,
-                "queue": self.tracer.begin("queue", parent=root,
-                                           t0=req.submit_t)}
-        self.queue.append(req)
+        with self._lock:
+            if self.tracer is not None:
+                # one trace per request, rooted at submit; span stamps
+                # reuse the scheduler's own clock reads so trace durations
+                # and the TTFT/latency accounting are the same numbers
+                root = self.tracer.begin(
+                    "request", trace_id=f"request:{req.request_id}",
+                    t0=req.submit_t, request_id=str(req.request_id),
+                    prompt_tokens=len(req.tokens))
+                self._req_spans[req] = {
+                    "root": root,
+                    "queue": self.tracer.begin("queue", parent=root,
+                                               t0=req.submit_t)}
+            self.queue.append(req)
 
     def _admit(self) -> None:
         """Fill free slots from the queue with ONE batched prefill call
         (per shared pow2 bucket) and record each admitted request's first
         sampled token."""
+        # caller holds self._lock (step())
         free = [i for i, r in enumerate(self.slots) if r is None]
         if not free or not self.queue:
             return
@@ -251,6 +263,7 @@ class ServeScheduler:
 
     # -------------------------------------------------------- lifecycle
     def _accept_token(self, req: Request, tok: int) -> None:
+        # caller holds self._lock (step()/_admit())
         req.generated.append(tok)
         if req.eos_id is not None and tok == req.eos_id:
             self._finish(req, "eos")
@@ -262,6 +275,7 @@ class ServeScheduler:
     def _close_trace(self, req: Request, marker: str, reason: str) -> None:
         """End a request's trace: close any still-open lifecycle spans at
         ``done_t``, drop a terminal marker span, close the root."""
+        # caller holds self._lock (_finish/_evict)
         sp = self._req_spans.pop(req, None)
         if sp is None or self.tracer is None:
             return
@@ -279,6 +293,7 @@ class ServeScheduler:
                         new_tokens=len(req.generated))
 
     def _finish(self, req: Request, reason: str) -> None:
+        # caller holds self._lock (_accept_token)
         req.state = "completed"
         req.finish_reason = reason
         req.done_t = time.perf_counter()
@@ -292,6 +307,7 @@ class ServeScheduler:
                       latency_s=round(req.latency_s or 0.0, 6))
 
     def _release(self, req: Request) -> None:
+        # caller holds self._lock (_finish/_evict)
         # the device-side length reset is deferred and batched: several
         # requests finishing on one tick cost ONE evict_slots call, and a
         # slot backfilled on the next tick needs no eviction at all
@@ -303,6 +319,7 @@ class ServeScheduler:
     def _flush_evictions(self) -> None:
         """One mask-shaped engine.evict for every slot freed since the
         last flush, skipping slots a prefill already reclaimed."""
+        # caller holds self._lock (step()/run())
         pending = {s for s in self._to_evict if self.slots[s] is None}
         if pending:
             self.engine.evict(sorted(pending))
@@ -311,19 +328,22 @@ class ServeScheduler:
     def abort(self, request_id) -> bool:
         """Mid-stream abort: evict a running request (or drop it from the
         queue). Other slots are untouched — bit-identical, by the static
-        shapes of the engine."""
-        for req in list(self.queue):
-            if req.request_id == request_id:
-                self.queue.remove(req)
-                self._evict(req, "aborted")
-                return True
-        for req in self.slots:
-            if req is not None and req.request_id == request_id:
-                self._evict(req, "aborted")
-                return True
-        return False
+        shapes of the engine. Safe to call from another thread while
+        :meth:`run` is mid-tick."""
+        with self._lock:
+            for req in list(self.queue):
+                if req.request_id == request_id:
+                    self.queue.remove(req)
+                    self._evict(req, "aborted")
+                    return True
+            for req in self.slots:
+                if req is not None and req.request_id == request_id:
+                    self._evict(req, "aborted")
+                    return True
+            return False
 
     def _evict(self, req: Request, reason: str) -> None:
+        # caller holds self._lock (abort()/run())
         req.state = "evicted"
         req.finish_reason = reason
         req.done_t = time.perf_counter()
@@ -339,42 +359,46 @@ class ServeScheduler:
     def step(self) -> bool:
         """One scheduler tick: scripted aborts -> backfill -> one decode
         step -> per-slot termination. Returns False when idle (no running
-        or queued work)."""
-        if self._t0 is None:
-            self._t0 = time.perf_counter()
-        if self.injector is not None:
-            for rid in self.injector.serve_aborts_due(self.decode_steps):
-                self.abort(rid)
-        self._admit()
-        active = np.array([r is not None for r in self.slots], bool)
-        if not active.any():
-            return bool(self.queue)
-        t0 = time.perf_counter()
-        next_tokens, _logits = self.engine.decode_step(
-            self.engine.last_tokens, active)
-        dt = time.perf_counter() - t0
-        self.decode_steps += 1
-        self.decode_step_s.append(dt)
-        self.decode_tokens += int(active.sum())
-        if self.tracer is not None:
-            if self._sched_span is None:
-                self._sched_span = self.tracer.begin(
-                    "serve", trace_id="serve:scheduler", t0=t0,
-                    num_slots=self.engine.config.num_slots)
-            tick = self.tracer.begin("decode_tick",
-                                     parent=self._sched_span, t0=t0,
-                                     step=self.decode_steps,
-                                     active=int(active.sum()))
-            self.tracer.end(tick, t1=t0 + dt)
-        if self.memory is not None:
-            self.memory.tick("serve_decode", step=self.decode_steps)
-        publish_event("serve_decode_step", seconds=dt,
-                      active=int(active.sum()))
-        for slot, req in enumerate(self.slots):
-            if req is not None:
-                self._accept_token(req, int(next_tokens[slot]))
-        self._flush_evictions()
-        return any(r is not None for r in self.slots) or bool(self.queue)
+        or queued work). Holds the scheduler lock for the whole tick — a
+        cross-thread submit/abort lands between ticks, never mid-tick."""
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = time.perf_counter()
+            if self.injector is not None:
+                for rid in self.injector.serve_aborts_due(
+                        self.decode_steps):
+                    self.abort(rid)
+            self._admit()
+            active = np.array([r is not None for r in self.slots], bool)
+            if not active.any():
+                return bool(self.queue)
+            t0 = time.perf_counter()
+            next_tokens, _logits = self.engine.decode_step(
+                self.engine.last_tokens, active)
+            dt = time.perf_counter() - t0
+            self.decode_steps += 1
+            self.decode_step_s.append(dt)
+            self.decode_tokens += int(active.sum())
+            if self.tracer is not None:
+                if self._sched_span is None:
+                    self._sched_span = self.tracer.begin(
+                        "serve", trace_id="serve:scheduler", t0=t0,
+                        num_slots=self.engine.config.num_slots)
+                tick = self.tracer.begin("decode_tick",
+                                         parent=self._sched_span, t0=t0,
+                                         step=self.decode_steps,
+                                         active=int(active.sum()))
+                self.tracer.end(tick, t1=t0 + dt)
+            if self.memory is not None:
+                self.memory.tick("serve_decode", step=self.decode_steps)
+            publish_event("serve_decode_step", seconds=dt,
+                          active=int(active.sum()))
+            for slot, req in enumerate(self.slots):
+                if req is not None:
+                    self._accept_token(req, int(next_tokens[slot]))
+            self._flush_evictions()
+            return any(r is not None
+                       for r in self.slots) or bool(self.queue)
 
     def run(self, max_steps: Optional[int] = None) -> ServeStats:
         """Run until idle (or ``max_steps`` decode steps); returns stats.
@@ -388,12 +412,13 @@ class ServeScheduler:
                     if max_steps is not None and \
                             self.decode_steps >= max_steps:
                         break
-                for req in list(self.queue) + [r for r in self.slots
-                                               if r is not None]:
-                    if req in self.queue:
-                        self.queue.remove(req)
-                    self._evict(req, "shutdown")
-                self._flush_evictions()
+                with self._lock:
+                    for req in list(self.queue) + [r for r in self.slots
+                                                   if r is not None]:
+                        if req in self.queue:
+                            self.queue.remove(req)
+                        self._evict(req, "shutdown")
+                    self._flush_evictions()
         finally:
             if self.tracer is not None and self._sched_span is not None:
                 self.tracer.end(self._sched_span,

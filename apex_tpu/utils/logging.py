@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import sys
+import threading
 import time
 import warnings
 from typing import Any, Callable, Dict, List, Optional
@@ -34,6 +35,12 @@ _event_subscribers: List[Callable[[Dict[str, Any]], None]] = []
 # how many distinct messages) it keeps raising
 _broken_subscribers: set = set()
 
+# guards the subscriber list and the broken-subscriber set: publishers run
+# on any thread (watchdog heartbeat, scheduler, bus subscribers that
+# publish), and (un)subscribe can race a concurrent publish's bookkeeping
+# (apexlint APX002 keeps this discipline)
+_bus_lock = threading.Lock()
+
 
 def subscribe_events(callback: Callable[[Dict[str, Any]], None]
                      ) -> Callable[[], None]:
@@ -43,17 +50,20 @@ def subscribe_events(callback: Callable[[Dict[str, Any]], None]
     cheap and non-throwing; a raising subscriber is reported once and the
     event still reaches the remaining subscribers.
     """
-    _event_subscribers.append(callback)
+    with _bus_lock:
+        _event_subscribers.append(callback)
 
     def _unsubscribe() -> None:
-        try:
-            _event_subscribers.remove(callback)
-        except ValueError:
-            pass
-        # drop the broken-subscriber mark with the subscription: ids of
-        # gc'd callables get reused, and a later unrelated subscriber at
-        # the same address must not inherit the suppression
-        _broken_subscribers.discard(id(callback))
+        with _bus_lock:
+            try:
+                _event_subscribers.remove(callback)
+            except ValueError:
+                pass
+            # drop the broken-subscriber mark with the subscription: ids
+            # of gc'd callables get reused, and a later unrelated
+            # subscriber at the same address must not inherit the
+            # suppression
+            _broken_subscribers.discard(id(callback))
 
     return _unsubscribe
 
@@ -75,12 +85,23 @@ def publish_event(event: str, *, level: str = "info", stream=None,
     # iterate a snapshot: a subscriber that (un)subscribes during delivery
     # (a flight recorder detaching itself, a one-shot waiter) must not
     # perturb this publish's fan-out
-    for cb in list(_event_subscribers):
+    with _bus_lock:
+        subscribers = list(_event_subscribers)
+    for cb in subscribers:
         try:
             cb(rec)
         except Exception as e:  # a broken consumer must not kill training
-            if id(cb) not in _broken_subscribers:
-                _broken_subscribers.add(id(cb))
+            with _bus_lock:
+                # re-check membership: an unsubscribe that raced this
+                # delivery already pruned the mark, and re-adding it for
+                # a now-gone callback would leak a stale id that a later
+                # subscriber at the same address could inherit
+                first_raise = (cb in _event_subscribers
+                               and id(cb) not in _broken_subscribers)
+                if first_raise:
+                    _broken_subscribers.add(id(cb))
+            if first_raise:
+                # warn outside the lock: one_time_warning writes stderr
                 one_time_warning(
                     f"event subscriber {cb!r} raised {type(e).__name__}: "
                     f"{e} (reported once; the event still reaches the "
@@ -178,10 +199,12 @@ class MetricLogger:
         self.stream = stream or sys.stderr
         self.meters: Dict[str, AverageMeter] = {}
         self._buffer: list = []
-        self._t0 = time.time()
+        # monotonic, not time.time(): the per-row `t` is a duration since
+        # logger construction, and wall clock steps under NTP (APX005)
+        self._t0 = time.monotonic()
 
     def log(self, step: int, **metrics: Any) -> None:
-        self._buffer.append((step, time.time() - self._t0, metrics))
+        self._buffer.append((step, time.monotonic() - self._t0, metrics))
         if self.print_every and step % self.print_every == 0:
             self.flush()
 

@@ -1,0 +1,563 @@
+"""apexlint framework + rule tests (marker: ``lint``).
+
+Three layers:
+
+1. **The repo is clean** — the full rule suite over ``apex_tpu/`` +
+   ``tools/`` yields zero active violations and zero unjustified
+   suppressions, both in-process and through the CLI (exit 0). A new
+   violation anywhere in the repo fails tier-1 here.
+2. **Every rule fires and stays quiet** — seeded fixture trees per rule
+   (the violation the rule exists for → exit 1; the disciplined spelling
+   → exit 0).
+3. **Suppression mechanics** — a justified ``# apexlint: disable=`` is
+   honored and *counted* in the JSON report; one without justification
+   text is itself a violation (APX000) and does not suppress.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from tools.apexlint.core import run_lint  # noqa: E402
+from tools.apexlint.cli import main as lint_main  # noqa: E402
+
+pytestmark = pytest.mark.lint
+
+
+def _fixture(tmp_path, relpath: str, source: str) -> str:
+    """Write one fixture module under a synthetic repo root."""
+    path = tmp_path / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    return str(path)
+
+
+def _run(tmp_path, rule: str):
+    active, suppressed, _ = run_lint(
+        root=str(tmp_path), paths=[str(tmp_path / "apex_tpu")],
+        only=[rule])
+    return active, suppressed
+
+
+# --------------------------------------------------------- 1. repo clean
+
+def test_repo_is_clean_with_zero_unjustified_suppressions():
+    active, suppressed, ctx = run_lint(root=ROOT)
+    assert not active, "\n".join(v.format() for v in active)
+    # every suppression that made it here carries its justification
+    assert all(v.justification for v in suppressed)
+    # the scan actually covered the package (not an empty-walk pass)
+    assert len(ctx.files) > 100
+
+
+def test_cli_clean_run_and_json_report():
+    r = subprocess.run([sys.executable, "-m", "tools.apexlint",
+                        "--format", "json"],
+                       capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["ok"] is True
+    assert doc["violations"] == []
+    # the watchdog's every-rank stack dump is the known justified opt-out
+    assert doc["suppressed_counts"].get("APX005", 0) >= 3
+    assert all(s["justification"] for s in doc["suppressed"])
+    assert set(doc["rules"]) == {"APX001", "APX002", "APX003", "APX004",
+                                 "APX005"}
+
+
+def test_console_script_shim_and_rule_listing(capsys):
+    from apex_tpu.lint_cli import main as shim_main
+
+    assert shim_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("APX001", "APX002", "APX003", "APX004", "APX005"):
+        assert rule_id in out
+
+
+# ------------------------------------------------- 2. fire/no-fire per rule
+
+def test_apx001_fires_on_host_effects_reachable_from_traced_code(tmp_path):
+    _fixture(tmp_path, "apex_tpu/bad.py", """\
+        import time
+        import jax
+
+        def helper(x):
+            t = time.perf_counter()
+            publish_event("stamp", seconds=t)
+            return x
+
+        @jax.jit
+        def step(x):
+            return helper(x) + 1
+
+        def body(c, x):
+            return c, x.item()
+
+        def run(xs):
+            return jax.lax.scan(body, 0, xs)
+        """)
+    active, _ = _run(tmp_path, "APX001")
+    msgs = [v.message for v in active]
+    assert len(active) == 3
+    assert any("perf_counter" in m for m in msgs)
+    assert any("publish_event" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+    # provenance names the traced root
+    assert any("step[@jit]" in m for m in msgs)
+    assert any("body[scan]" in m for m in msgs)
+
+
+def test_apx001_quiet_on_pure_traced_code_and_host_only_effects(tmp_path):
+    _fixture(tmp_path, "apex_tpu/good.py", """\
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def pure(x):
+            return jnp.tanh(x) * 2.0
+
+        @jax.jit
+        def step(x):
+            return pure(x)
+
+        def host_loop(xs):
+            # host-side timing around the jitted call is exactly right
+            t0 = time.perf_counter()
+            y = step(xs)
+            return y, time.perf_counter() - t0
+        """)
+    active, _ = _run(tmp_path, "APX001")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx001_boundary_functions_end_the_traversal(tmp_path):
+    _fixture(tmp_path, "apex_tpu/tuned.py", """\
+        import jax
+
+        def tuned_params(kernel, **shape):
+            # sanctioned trace-time host work (cache read + provenance)
+            with open("/tmp/cache.json") as f:
+                pass
+            return {"block": 128}
+
+        @jax.jit
+        def kernel_wrapper(x):
+            p = tuned_params("k", rows=x.shape[0])
+            return x * p["block"]
+        """)
+    active, _ = _run(tmp_path, "APX001")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx002_fires_on_lock_free_rmw(tmp_path):
+    _fixture(tmp_path, "apex_tpu/counter.py", """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self.items = []
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+                    self.items.append(self.n)
+
+            def sneaky(self):
+                self.n += 1
+                self.items.append(0)
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 2
+    assert all("lock-free" in v.message for v in active)
+    assert {v.line for v in active} == {15, 16}
+
+
+def test_apx002_quiet_on_disciplined_and_marked_code(tmp_path):
+    _fixture(tmp_path, "apex_tpu/counter.py", """\
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+                self.snapshot = None
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def _bump(self):
+                # caller holds self._lock
+                self.n += 1
+
+            def publish(self):
+                # plain rebinding outside the lock is the snapshot idiom
+                self.snapshot = {"n": 0}
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx002_wrong_lock_is_flagged(tmp_path):
+    """Holding *a* lock is not holding *the* lock: two locks 'guarding'
+    one name exclude nothing."""
+    _fixture(tmp_path, "apex_tpu/twolocks.py", """\
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._dump_lock = threading.Lock()
+                self.ring = []
+
+            def on_event(self, rec):
+                with self._lock:
+                    self.ring.append(rec)
+
+            def drain(self):
+                with self._dump_lock:
+                    self.ring.pop()
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 2          # both disagreeing sites are flagged
+    assert all("pick one" in v.message for v in active)
+
+
+def test_apx002_sees_annotated_and_class_attr_locks(tmp_path):
+    """A type annotation (`self._lock: Lock = Lock()`) or the class-attr
+    idiom must not blind the rule."""
+    _fixture(tmp_path, "apex_tpu/annotated.py", """\
+        import threading
+        from threading import Lock
+
+        class A:
+            def __init__(self):
+                self._lock: Lock = threading.Lock()
+                self.n = 0
+
+            def inc(self):
+                with self._lock:
+                    self.n += 1
+
+            def sneaky(self):
+                self.n += 1
+
+        class B:
+            _lock = threading.Lock()
+
+            def __init__(self):
+                self.items = []
+
+            def put(self, x):
+                with self._lock:
+                    self.items.append(x)
+
+            def sneaky(self):
+                self.items.pop()
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 2, [v.format() for v in active]
+    assert {v.line for v in active} == {14, 27}
+
+
+def test_apx002_module_level_bus_discipline(tmp_path):
+    _fixture(tmp_path, "apex_tpu/bus.py", """\
+        import threading
+
+        _lock = threading.Lock()
+        _subs = []
+
+        def ok(cb):
+            with _lock:
+                _subs.append(cb)
+
+        def bad(cb):
+            _subs.append(cb)
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 1 and active[0].line == 11
+
+
+def _schema_fixture(tmp_path):
+    _fixture(tmp_path, "apex_tpu/monitor/goodput.py", """\
+        STALL_EVENTS = {"checkpoint_save_stall": "checkpoint_save"}
+        COUNTED_EVENTS = ("overflow_step_skipped",)
+        INFO_EVENTS = ("span_open",)
+        EVENT_SCHEMA = (frozenset(STALL_EVENTS) | frozenset(COUNTED_EVENTS)
+                        | frozenset(INFO_EVENTS))
+        """)
+
+
+def test_apx003_fires_on_unregistered_event(tmp_path):
+    _schema_fixture(tmp_path)
+    _fixture(tmp_path, "apex_tpu/pub.py", """\
+        from apex_tpu.utils.logging import publish_event, structured_warning
+
+        def go():
+            publish_event("overflow_step_skipped", steps=1)
+            publish_event("totally_new_event", steps=1)
+            structured_warning("another_rogue_event")
+            publish_event(some_variable)  # non-literal: out of scope
+        """)
+    active, _ = _run(tmp_path, "APX003")
+    assert len(active) == 2
+    assert {"totally_new_event" in v.message or
+            "another_rogue_event" in v.message for v in active} == {True}
+
+
+def test_apx003_quiet_when_every_event_registered(tmp_path):
+    _schema_fixture(tmp_path)
+    _fixture(tmp_path, "apex_tpu/pub.py", """\
+        from apex_tpu.utils.logging import publish_event
+
+        def go():
+            publish_event("overflow_step_skipped", steps=1)
+            publish_event("span_open", emit=False)
+            publish_event(event="checkpoint_save_stall", seconds=1.0)
+        """)
+    active, _ = _run(tmp_path, "APX003")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx004_fires_on_torn_write_and_quiet_on_atomic(tmp_path):
+    _fixture(tmp_path, "apex_tpu/bad_checkpoint.py", """\
+        import numpy as np
+
+        def save_checkpoint(path, arr):
+            np.savez(path, arr=arr)
+        """)
+    active, _ = _run(tmp_path, "APX004")
+    assert len(active) == 1 and "non-atomic" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "bad_checkpoint.py"
+    good.write_text(textwrap.dedent("""\
+        import numpy as np, os
+
+        def save_checkpoint(path, arr):
+            with open(path + '.tmp', 'wb') as f:
+                np.savez(f, arr=arr)
+            os.replace(path + '.tmp', path)
+        """))
+    active, _ = _run(tmp_path, "APX004")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx005_fires_on_wall_clock_delta_and_ungated_print(tmp_path):
+    _fixture(tmp_path, "apex_tpu/clocks.py", """\
+        import time
+
+        class T:
+            def __init__(self):
+                self._t0 = time.time()
+
+            def elapsed(self):
+                return time.time() - self._t0
+
+        def announce():
+            print("starting up")
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert len(active) == 2
+    assert any("monotonic" in v.message for v in active)
+    assert any("ungated print" in v.message for v in active)
+
+
+def test_apx005_sees_annotated_wall_clock_stores(tmp_path):
+    _fixture(tmp_path, "apex_tpu/annstore.py", """\
+        import time
+
+        class T:
+            def __init__(self):
+                self._t0: float = time.time()
+
+            def elapsed(self):
+                return time.monotonic() - self._t0
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert len(active) == 1 and "monotonic" in active[0].message
+
+
+def test_apx005_quiet_on_monotonic_gated_and_cli_prints(tmp_path):
+    _fixture(tmp_path, "apex_tpu/clocks.py", """\
+        import time
+
+        CREATED = time.time()   # wall-clock stamp, never subtracted: fine
+
+        def elapsed(t0):
+            return time.perf_counter() - t0
+
+        def banner():
+            from apex_tpu.utils.logging import is_rank_zero
+            if is_rank_zero():
+                print("one banner across the fleet")
+        """)
+    _fixture(tmp_path, "apex_tpu/cli.py", """\
+        def main():
+            print("a CLI's stdout is its interface")
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert not active, [v.format() for v in active]
+
+
+# --------------------------------------------------- 3. suppressions
+
+def test_justified_suppression_suppresses_and_is_counted(tmp_path):
+    _fixture(tmp_path, "apex_tpu/sup.py", """\
+        import time
+
+        def elapsed(t0):
+            return time.time() - t0  # apexlint: disable=APX005 -- comparing against a file mtime, which is wall clock
+        """)
+    active, suppressed = _run(tmp_path, "APX005")
+    assert not active
+    assert len(suppressed) == 1
+    assert suppressed[0].justification.startswith("comparing against")
+
+    # and the CLI JSON report carries the count
+    r = subprocess.run([sys.executable, "-m", "tools.apexlint",
+                        "--root", str(tmp_path), "--rules", "APX005",
+                        "--format", "json", str(tmp_path / "apex_tpu")],
+                       capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["ok"] is True
+    assert doc["suppressed_counts"] == {"APX005": 1}
+    assert doc["suppressed"][0]["justification"].startswith("comparing")
+
+
+def test_unjustified_suppression_is_itself_a_violation(tmp_path):
+    _fixture(tmp_path, "apex_tpu/sup.py", """\
+        import time
+
+        def elapsed(t0):
+            return time.time() - t0  # apexlint: disable=APX005
+        """)
+    active, suppressed, _ = run_lint(root=str(tmp_path),
+                                     paths=[str(tmp_path / "apex_tpu")])
+    assert not suppressed
+    rules = sorted(v.rule_id for v in active)
+    # the original violation STANDS and the bare disable is flagged
+    assert rules == ["APX000", "APX005"]
+    assert "justification" in [v for v in active
+                               if v.rule_id == "APX000"][0].message
+
+
+def test_suppression_on_preceding_line_covers_long_statements(tmp_path):
+    _fixture(tmp_path, "apex_tpu/sup.py", """\
+        import time
+
+        def elapsed(t0):
+            # apexlint: disable=APX005 -- wall-clock comparison vs an externally stamped epoch
+            return time.time() - t0
+        """)
+    active, suppressed = _run(tmp_path, "APX005")
+    assert not active and len(suppressed) == 1
+
+
+def test_cli_exit_one_on_seeded_violation_each_rule(tmp_path):
+    """The acceptance contract: a seeded violation of each rule exits 1
+    through the real CLI."""
+    seeds = {
+        "APX001": """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                print("tracing", x)
+                return x
+            """,
+        "APX002": """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def a(self):
+                    with self._lock:
+                        self.n += 1
+
+                def b(self):
+                    self.n += 1
+            """,
+        "APX003": None,  # needs the schema fixture, seeded below
+        "APX004": """\
+            import numpy as np
+
+            def save_checkpoint(path, arr):
+                np.savez(path, arr=arr)
+            """,
+        "APX005": """\
+            import time
+
+            def dur(t0):
+                return time.time() - t0
+            """,
+    }
+    for rule, src in seeds.items():
+        seed_root = tmp_path / rule
+        if rule == "APX003":
+            _schema_fixture(seed_root)
+            _fixture(seed_root, "apex_tpu/pub.py", """\
+                from apex_tpu.utils.logging import publish_event
+
+                def go():
+                    publish_event("rogue_event")
+                """)
+        else:
+            _fixture(seed_root, "apex_tpu/seed.py", src)
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.apexlint", "--root",
+             str(seed_root), "--rules", rule, str(seed_root / "apex_tpu")],
+            capture_output=True, text=True, cwd=ROOT)
+        assert r.returncode == 1, \
+            f"{rule}: expected exit 1, got {r.returncode}\n{r.stdout}"
+        assert rule in r.stdout
+
+
+def test_unused_suppression_is_flagged_only_when_its_rule_ran(tmp_path):
+    _fixture(tmp_path, "apex_tpu/stale.py", """\
+        import time
+
+        def now():
+            return time.monotonic()  # apexlint: disable=APX005 -- was a time.time delta once, fixed since
+        """)
+    # APX005 ran and found nothing on that line → the opt-out is stale
+    active, suppressed = run_lint(root=str(tmp_path),
+                                  paths=[str(tmp_path / "apex_tpu")],
+                                  only=["APX005"])[:2]
+    assert not suppressed
+    assert [v.rule_id for v in active] == ["APX000"]
+    assert "unused suppression" in active[0].message
+    # a subset run that did NOT include APX005 cannot judge it
+    active, suppressed = run_lint(root=str(tmp_path),
+                                  paths=[str(tmp_path / "apex_tpu")],
+                                  only=["APX004"])[:2]
+    assert not active and not suppressed
+
+
+def test_nonexistent_path_is_a_usage_error_not_a_clean_pass():
+    assert lint_main(["--root", ROOT, "no_such_dir_xyz"]) == 2
+
+
+def test_path_outside_lint_root_is_a_usage_error(tmp_path):
+    """A file outside --root has no repo-relative identity: path-scoped
+    rules would silently skip it and the run would read clean while
+    checking nothing."""
+    outside = _fixture(tmp_path, "elsewhere/x.py", "import time\n")
+    assert lint_main(["--root", ROOT, outside]) == 2
+
+
+def test_unknown_rule_id_is_a_usage_error():
+    assert lint_main(["--rules", "APX999", "--list-rules"]) == 2

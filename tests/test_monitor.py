@@ -243,34 +243,43 @@ def test_serve_events_registered():
 def test_repo_wide_event_schema_audit():
     """EVERY literal ``publish_event``/``structured_warning`` call site in
     the package must use a name registered in the goodput/event schema
-    (STALL | COUNTED | INFO) — the repo-wide generalization of the
-    serve-only grep above, so a new subsystem cannot ship an event no
-    monitoring consumer knows about."""
-    import re
-
-    import apex_tpu
+    (STALL | COUNTED | INFO) — so a new subsystem cannot ship an event no
+    monitoring consumer knows about. The audit itself is apexlint rule
+    APX003 (AST-based, one source of truth — this test delegates instead
+    of keeping its own regex scan, and proves the rule still *fires*)."""
+    sys.path.insert(0, ROOT)
+    try:
+        from tools.apexlint.core import LintContext
+        from tools.apexlint.rules.event_schema import (EventSchemaRule,
+                                                       load_event_schema)
+    finally:
+        sys.path.pop(0)
     from apex_tpu.monitor.goodput import EVENT_SCHEMA
 
-    pattern = re.compile(
-        r'(?:publish_event|structured_warning)\(\s*["\']([a-z_0-9]+)["\']')
-    sites = []           # (relpath, event_name) per literal call site
-    pkg_dir = os.path.dirname(apex_tpu.__file__)
-    for dirpath, dirnames, filenames in os.walk(pkg_dir):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                for name in pattern.findall(f.read()):
-                    sites.append((os.path.relpath(path, pkg_dir), name))
-    # sanity: the regex still matches the real call sites (the seed had
-    # 20 across 10 files; this PR added trace/memory/flight publishers)
+    # the rule audits against the same schema the runtime exposes
+    assert load_event_schema(ROOT) == EVENT_SCHEMA
+
+    ctx = LintContext(ROOT, [os.path.join(ROOT, "apex_tpu")])
+    violations = list(EventSchemaRule().check(ctx))
+    assert not violations, \
+        "events missing from the monitor.goodput schema:\n" + \
+        "\n".join(v.format() for v in violations)
+
+    # sanity: the rule still SEES the real call sites — a refactor that
+    # blinds the audit (renamed publish funcs, moved schema) must fail
+    # here, not silently pass (the seed had ≈31 sites across ≥10 files)
+    from tools.apexlint.rules.event_schema import _event_name_arg
+    import ast as _ast
+
+    sites = []
+    for sf in ctx.iter_files(under="apex_tpu"):
+        for node in _ast.walk(sf.tree):
+            if isinstance(node, _ast.Call):
+                arg = _event_name_arg(node)
+                if arg is not None:
+                    sites.append((sf.path, arg.value))
     assert len(sites) >= 25, sites
     assert len({p for p, _ in sites}) >= 10
-    unregistered = {name for _, name in sites} - EVENT_SCHEMA
-    assert not unregistered, \
-        f"events missing from the monitor.goodput schema: {unregistered}"
 
 
 def test_raising_subscriber_isolated_once(capsys):

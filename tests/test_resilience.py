@@ -395,10 +395,16 @@ def test_structured_warning_record_and_json():
 
 @pytest.mark.fault
 def test_check_durability_tool_clean_and_catches_violation(tmp_path):
+    # the durability checker is apexlint rule APX004 now — the canonical
+    # entry point is the linter; the old script stays a working shim
+    r = subprocess.run([sys.executable, "-m", "tools.apexlint",
+                        "--rules", "APX004", "apex_tpu"],
+                       capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
     r = subprocess.run([sys.executable,
                         os.path.join(ROOT, "tools", "check_durability.py")],
                        capture_output=True, text=True, cwd=ROOT)
-    assert r.returncode == 0, r.stderr
+    assert r.returncode == 0, r.stdout + r.stderr
 
     sys.path.insert(0, os.path.join(ROOT, "tools"))
     try:

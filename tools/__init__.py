@@ -1,0 +1,4 @@
+# Makes ``tools`` importable so ``python -m tools.apexlint`` works from the
+# repo root. The standalone scripts in this directory still run directly
+# (``python tools/check_durability.py``) — being a package does not change
+# script execution.

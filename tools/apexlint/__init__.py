@@ -1,0 +1,41 @@
+"""apexlint — AST-based invariant linter for the apex_tpu repo.
+
+The codebase rests on invariants that used to be enforced only by
+convention or one-off checks: jitted code must stay host-effect-free,
+cross-thread state must be mutated behind its lock, event names must be
+registered in the goodput schema, durable artifacts must commit
+atomically, and duration math must use a monotonic clock. This package
+makes each of them a mechanical check:
+
+========  ==================================================================
+APX001    trace purity — no host effects reachable from traced code
+          (``jax.jit`` / ``shard_map`` / ``lax.scan`` / ``pallas_call``)
+APX002    lock discipline — attributes mutated under ``self._lock`` may not
+          be read-modify-written outside it
+APX003    event schema — every literal ``publish_event`` /
+          ``structured_warning`` name must be registered in
+          ``apex_tpu.monitor.goodput``
+APX004    durability — durable artifacts commit via ``.tmp`` +
+          ``os.replace`` (the former ``tools/check_durability.py``)
+APX005    clock hygiene — no ``time.time()`` deltas in duration math, no
+          ungated ``print`` outside CLI / logging modules
+APX000    suppression discipline — every ``# apexlint: disable=`` comment
+          must carry a justification (always on; cannot be suppressed)
+========  ==================================================================
+
+Run ``apex-tpu-lint`` (or ``python -m tools.apexlint``) from the repo
+root; see docs/static-analysis.md for the rule catalog, the suppression
+policy, and how to add a rule.
+"""
+
+from .core import (  # noqa: F401
+    LintContext,
+    Rule,
+    Violation,
+    get_rules,
+    register,
+    run_lint,
+)
+
+__all__ = ["LintContext", "Rule", "Violation", "get_rules", "register",
+           "run_lint"]

@@ -1,0 +1,7 @@
+"""``python -m tools.apexlint`` — see cli.py for the contract."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

@@ -1,0 +1,65 @@
+"""``apex-tpu-lint`` / ``python -m tools.apexlint`` entry point.
+
+Exit-code contract (what CI keys on):
+
+- ``0`` — no active violations (justified suppressions are fine),
+- ``1`` — at least one violation (including APX000 unjustified-suppression
+  and unparseable files),
+- ``2`` — usage error (unknown rule id, bad path).
+
+Default scan set is ``apex_tpu/`` + ``tools/`` under the repo root; pass
+explicit files/directories to narrow it (fixture tests do).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .core import REPO_ROOT, get_rules, run_lint
+from .reporters import report_json, report_text
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="apex-tpu-lint",
+        description="AST-based invariant linter for apex_tpu "
+                    "(see docs/static-analysis.md)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to scan (default: "
+                             "apex_tpu/ and tools/ under --root)")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="repo root (default: autodetected)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit 0")
+    args = parser.parse_args(argv)
+
+    only = ([r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None)
+    try:
+        if args.list_rules:
+            for rule in get_rules(only):
+                scope = getattr(rule, "SCOPE", None)
+                where = f"[{scope}/]" if scope else "[all files]"
+                print(f"{rule.RULE_ID}  {where}  {rule.SUMMARY}")
+            return 0
+        active, suppressed, ctx = run_lint(
+            root=args.root, paths=args.paths or None, only=only)
+    except (KeyError, OSError) as e:
+        print(f"apex-tpu-lint: {e}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        report_json(active, suppressed, ctx, get_rules(only), sys.stdout)
+    else:
+        report_text(active, suppressed, ctx, sys.stdout)
+    return 1 if active else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""apexlint framework: rule registry, lint context, suppressions.
+
+A *rule* is a class with a ``RULE_ID``/``SUMMARY`` and a ``check(ctx)``
+generator yielding :class:`Violation`. Rules register themselves with the
+:func:`register` decorator; :func:`run_lint` runs every (selected) rule
+over a :class:`LintContext` and applies the suppression policy:
+
+- ``# apexlint: disable=APX001 -- <justification>`` on a violation's line
+  (or on the line directly above, for lines with no room) suppresses that
+  rule at that site. The justification text after ``--`` is **mandatory**:
+  a disable comment without one is itself a violation (APX000), so the
+  repo can never accumulate silent opt-outs.
+- Suppressed violations are counted and carried in the JSON report —
+  a suppression is a visible, audited decision, not a deletion.
+
+The context parses each file once (AST + source lines cached) so five
+rules over ~200 files stay fast enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Type
+
+# repo root = parent of tools/ (this file lives at tools/apexlint/core.py)
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+SUPPRESS_RE = re.compile(
+    r"#\s*apexlint:\s*disable=(?P<rules>[A-Z0-9,\s]+?)"
+    r"(?:\s*--\s*(?P<why>.*\S))?\s*$")
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding: rule, location, message. ``suppressed``/``why`` are
+    filled in by the framework when a justified disable comment matches."""
+
+    rule_id: str
+    path: str                 # repo-relative
+    line: int
+    message: str
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule_id} {self.message}"
+
+    def as_json(self) -> Dict[str, object]:
+        out: Dict[str, object] = {
+            "rule": self.rule_id, "path": self.path, "line": self.line,
+            "message": self.message,
+        }
+        if self.suppressed:
+            out["suppressed"] = True
+            out["justification"] = self.justification
+        return out
+
+
+@dataclasses.dataclass
+class _Suppression:
+    line: int                     # the line the comment sits on
+    rules: Tuple[str, ...]
+    justification: Optional[str]  # None → unjustified (an APX000 violation)
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed file: source, lines, AST (None when unparseable),
+    suppression comments."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.path = relpath
+        with open(abspath, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(self.source)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = f"line {e.lineno}: {e.msg}"
+        self.suppressions: List[_Suppression] = []
+        if "apexlint" in self.source:
+            # real COMMENT tokens only — a disable spelled inside a
+            # docstring (this framework documents its own syntax...) is
+            # prose, not a suppression
+            try:
+                tokens = list(tokenize.generate_tokens(
+                    io.StringIO(self.source).readline))
+            except (tokenize.TokenError, SyntaxError, IndentationError):
+                tokens = []
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = tuple(r.strip()
+                                  for r in m.group("rules").split(",")
+                                  if r.strip())
+                    self.suppressions.append(
+                        _Suppression(tok.start[0], rules, m.group("why")))
+
+    def segment(self, node: ast.AST) -> str:
+        """Source text of a node (used by rules for marker-comment
+        evidence, e.g. ``# caller holds self._lock``)."""
+        end = getattr(node, "end_lineno", node.lineno)
+        return "\n".join(self.lines[node.lineno - 1:end])
+
+
+class LintContext:
+    """The scanned file set. ``files`` preserves a stable sorted order so
+    reports are deterministic."""
+
+    def __init__(self, root: str, paths: Optional[Iterable[str]] = None):
+        self.root = os.path.abspath(root)
+        self.files: List[SourceFile] = []
+        self._by_path: Dict[str, SourceFile] = {}
+        for p in self._collect(paths):
+            rel = os.path.relpath(p, self.root)
+            if rel.startswith(".."):
+                # a file outside --root has no repo-relative identity, so
+                # every path-scoped rule would silently skip it and the
+                # run would read "clean" while checking nothing
+                raise OSError(
+                    f"{p} is outside the lint root {self.root} — pass "
+                    f"--root, or lint from the repo that owns the file")
+            sf = SourceFile(p, rel)
+            self.files.append(sf)
+            self._by_path[rel] = sf
+
+    def _collect(self, paths: Optional[Iterable[str]]) -> List[str]:
+        if paths is None:
+            paths = [os.path.join(self.root, "apex_tpu"),
+                     os.path.join(self.root, "tools")]
+        out: List[str] = []
+        for p in paths:
+            p = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if os.path.isfile(p):
+                out.append(p)
+                continue
+            if not os.path.isdir(p):
+                # a typo'd CI path must be a loud usage error, not a
+                # silent 0-files-scanned "clean" pass
+                raise OSError(f"no such file or directory: {p}")
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        return sorted(set(out))
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        return self._by_path.get(relpath)
+
+    def iter_files(self, *, under: Optional[str] = None
+                   ) -> Iterator[SourceFile]:
+        """Files whose repo-relative path starts with ``under`` (a
+        directory prefix like ``apex_tpu``); all files when None."""
+        for sf in self.files:
+            if under is None or sf.path == under or \
+                    sf.path.startswith(under.rstrip(os.sep) + os.sep):
+                yield sf
+
+
+class Rule:
+    """Base class. Subclasses set ``RULE_ID`` (``APXnnn``) and ``SUMMARY``
+    and implement ``check(ctx)`` yielding :class:`Violation`."""
+
+    RULE_ID = "APX000"
+    SUMMARY = ""
+
+    def check(self, ctx: LintContext) -> Iterator[Violation]:
+        raise NotImplementedError
+
+    def violation(self, sf: SourceFile, line: int, message: str) -> Violation:
+        return Violation(self.RULE_ID, sf.path, line, message)
+
+
+_REGISTRY: Dict[str, Type[Rule]] = {}
+
+
+def register(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator: add a rule to the registry (keyed by RULE_ID)."""
+    if cls.RULE_ID in _REGISTRY:
+        raise ValueError(f"duplicate rule id {cls.RULE_ID}")
+    _REGISTRY[cls.RULE_ID] = cls
+    return cls
+
+
+def get_rules(only: Optional[Iterable[str]] = None) -> List[Rule]:
+    """Instantiate registered rules (all, or the ``only`` subset).
+    Importing ``tools.apexlint.rules`` populates the registry."""
+    from . import rules  # noqa: F401  (side effect: rule registration)
+
+    ids = sorted(_REGISTRY)
+    if only is not None:
+        only = list(only)
+        unknown = sorted(set(only) - set(ids))
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {', '.join(unknown)}; "
+                           f"known: {', '.join(ids)}")
+        ids = [i for i in ids if i in only]
+    return [_REGISTRY[i]() for i in ids]
+
+
+def _apply_suppressions(ctx: LintContext, violations: List[Violation],
+                        run_rules: Iterable[str]) -> List[Violation]:
+    """Mark violations covered by a justified disable on the same line or
+    the line directly above; emit APX000 for unjustified disables and for
+    suppressions that no longer suppress anything."""
+    run_rules = set(run_rules)
+    for v in violations:
+        sf = ctx.file(v.path)
+        if sf is None:
+            continue
+        for sup in sf.suppressions:
+            if sup.line not in (v.line, v.line - 1):
+                continue
+            if v.rule_id not in sup.rules or v.rule_id == "APX000":
+                continue
+            sup.used = True
+            if sup.justification:
+                v.suppressed = True
+                v.justification = sup.justification
+            # an unjustified disable does NOT suppress — the violation
+            # stands, and APX000 below flags the comment itself
+    extra: List[Violation] = []
+    for sf in ctx.files:
+        for sup in sf.suppressions:
+            if not sup.justification:
+                extra.append(Violation(
+                    "APX000", sf.path, sup.line,
+                    f"suppression of {','.join(sup.rules)} without a "
+                    f"justification (write `# apexlint: "
+                    f"disable={','.join(sup.rules)} -- <why>`)"))
+            elif not sup.used and set(sup.rules) <= run_rules:
+                # a stale opt-out hides nothing but reads as if it did —
+                # the audited-decision policy cuts both ways. Only when
+                # every referenced rule actually ran: a --rules subset
+                # cannot judge a foreign suppression unused.
+                extra.append(Violation(
+                    "APX000", sf.path, sup.line,
+                    f"unused suppression of {','.join(sup.rules)} — no "
+                    f"matching violation on this line; delete the stale "
+                    f"comment"))
+    return violations + extra
+
+
+def run_lint(root: str = REPO_ROOT,
+             paths: Optional[Iterable[str]] = None,
+             only: Optional[Iterable[str]] = None
+             ) -> Tuple[List[Violation], List[Violation], LintContext]:
+    """Run (selected) rules over ``paths``; returns ``(active,
+    suppressed, ctx)`` with active sorted by (path, line, rule)."""
+    ctx = LintContext(root, paths)
+    rules = get_rules(only)
+    found: List[Violation] = []
+    for rule in rules:
+        found.extend(rule.check(ctx))
+    found = _apply_suppressions(ctx, found,
+                                [r.RULE_ID for r in rules])
+    for sf in ctx.files:
+        if sf.parse_error is not None:
+            found.append(Violation("APX000", sf.path, 0,
+                                   f"unparseable: {sf.parse_error}"))
+    found.sort(key=lambda v: (v.path, v.line, v.rule_id))
+    active = [v for v in found if not v.suppressed]
+    suppressed = [v for v in found if v.suppressed]
+    return active, suppressed, ctx

@@ -1,0 +1,51 @@
+"""Violation reporters: human text and machine JSON.
+
+The JSON shape is the contract CI consumers read::
+
+    {"ok": bool, "violations": [...], "suppressed": [...],
+     "counts": {"APX001": 2, ...}, "suppressed_counts": {...},
+     "files_scanned": N, "rules": {"APX001": "summary", ...}}
+
+``suppressed`` entries carry their mandatory justification text, so an
+audit of every opt-out in the repo is one ``jq`` away.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, TextIO
+
+from .core import LintContext, Rule, Violation
+
+
+def report_text(active: List[Violation], suppressed: List[Violation],
+                ctx: LintContext, stream: TextIO) -> None:
+    for v in active:
+        print(v.format(), file=stream)
+    tail = (f"apexlint: {len(active)} violation(s), "
+            f"{len(suppressed)} suppressed, "
+            f"{len(ctx.files)} file(s) scanned")
+    print(tail, file=stream)
+
+
+def _counts(violations: List[Violation]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for v in violations:
+        out[v.rule_id] = out.get(v.rule_id, 0) + 1
+    return out
+
+
+def report_json(active: List[Violation], suppressed: List[Violation],
+                ctx: LintContext, rules: List[Rule],
+                stream: TextIO) -> None:
+    payload = {
+        "ok": not active,
+        "violations": [v.as_json() for v in active],
+        "suppressed": [v.as_json() for v in suppressed],
+        "counts": _counts(active),
+        "suppressed_counts": _counts(suppressed),
+        "files_scanned": len(ctx.files),
+        "rules": {r.RULE_ID: r.SUMMARY for r in rules},
+    }
+    json.dump(payload, stream, indent=1, sort_keys=True)
+    stream.write("\n")

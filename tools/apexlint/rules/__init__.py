@@ -1,0 +1,7 @@
+"""Rule modules — importing this package registers every rule."""
+
+from . import clock_hygiene  # noqa: F401
+from . import durability  # noqa: F401
+from . import event_schema  # noqa: F401
+from . import lock_discipline  # noqa: F401
+from . import trace_purity  # noqa: F401

@@ -1,0 +1,150 @@
+"""APX005 — clock hygiene: monotonic deltas, no ungated prints.
+
+Two checks over ``apex_tpu/``:
+
+**time.time() deltas.** ``time.time()`` is wall clock — NTP steps it
+backwards and forwards — so subtracting two reads is not a duration.
+Every duration/tracing measurement must use ``time.monotonic()`` or
+``time.perf_counter()``. The rule flags any subtraction whose operands
+involve a ``time.time()`` call directly or a name/attribute that is
+assigned ``time.time()`` anywhere in the same file. Bare ``time.time()``
+reads that never enter arithmetic (wall-clock provenance stamps like a
+checkpoint's ``created`` field) are fine — that is exactly what wall
+clock is for.
+
+**ungated print.** PR 4 established that console output in library code
+is rank-0-gated (``utils.logging.is_rank_zero``) so an N-host run prints
+one banner, not N interleaved ones. The rule flags ``print`` calls in
+``apex_tpu/`` unless (a) the module is a CLI entry point (``*/cli.py``,
+``bench_cli.py`` — a CLI's stdout IS its interface and CLIs are
+single-process), (b) the module is ``utils/logging.py`` (the funnel
+every gated print is supposed to go through), or (c) the enclosing
+function shows rank-0 gating (``is_rank_zero`` in its source). A
+deliberate every-rank print (the watchdog's stack dump) carries a
+justified ``# apexlint: disable=APX005`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Set
+
+from ..core import LintContext, Rule, SourceFile, Violation, register
+
+# modules whose stdout/stderr output is their interface (exact basenames
+# — a suffix match would silently exempt any future `*cli.py` module)
+PRINT_OK_FILES = frozenset({"cli.py", "bench_cli.py", "lint_cli.py"})
+PRINT_OK_PATHS = (os.path.join("utils", "logging.py"),)
+GATE_EVIDENCE = "is_rank_zero"
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id == "time")
+
+
+def _target_keys(node: ast.AST) -> Set[str]:
+    """Stable keys for assignment targets we track: bare names and
+    ``self.x`` / ``obj.x`` attributes (keyed by their dotted tail)."""
+    keys: Set[str] = set()
+    if isinstance(node, ast.Name):
+        keys.add(node.id)
+    elif isinstance(node, ast.Attribute):
+        keys.add(node.attr)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            keys |= _target_keys(elt)
+    return keys
+
+
+class _FileScan(ast.NodeVisitor):
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        # names/attrs assigned time.time() anywhere in the file — the
+        # "stored wall-clock read" half of a delta
+        self.wall_names: Set[str] = set()
+        self.subs: list = []      # (lineno, node) Sub BinOps
+        self.prints: list = []    # (lineno, enclosing function node|None)
+        self._func_stack: list = []
+
+    def visit_FunctionDef(self, node):
+        self._func_stack.append(node)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Assign(self, node):
+        if _is_time_time(node.value):
+            for t in node.targets:
+                self.wall_names |= _target_keys(t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        # `self._t0: float = time.time()` stores wall clock all the same
+        if node.value is not None and _is_time_time(node.value):
+            self.wall_names |= _target_keys(node.target)
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node):
+        if isinstance(node.op, ast.Sub):
+            self.subs.append(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            self.prints.append(
+                (node.lineno,
+                 self._func_stack[-1] if self._func_stack else None))
+        self.generic_visit(node)
+
+
+def _sub_involves_wall_clock(node: ast.BinOp, wall_names: Set[str]) -> bool:
+    for side in (node.left, node.right):
+        for sub in ast.walk(side):
+            if _is_time_time(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in wall_names:
+                return True
+            if isinstance(sub, ast.Attribute) and sub.attr in wall_names:
+                return True
+    return False
+
+
+@register
+class ClockHygieneRule(Rule):
+    RULE_ID = "APX005"
+    SUMMARY = ("durations use monotonic clocks (no time.time() deltas); "
+               "no ungated print in library code")
+
+    SCOPE = "apex_tpu"
+
+    def check(self, ctx: LintContext) -> Iterator[Violation]:
+        for sf in ctx.iter_files(under=self.SCOPE):
+            if sf.tree is None:
+                continue
+            scan = _FileScan(sf)
+            scan.visit(sf.tree)
+            for node in scan.subs:
+                if _sub_involves_wall_clock(node, scan.wall_names):
+                    yield self.violation(
+                        sf, node.lineno,
+                        "duration computed from time.time() — wall clock "
+                        "steps under NTP; use time.monotonic() or "
+                        "time.perf_counter() for deltas")
+            if os.path.basename(sf.path) in PRINT_OK_FILES or \
+                    any(sf.path.endswith(p) for p in PRINT_OK_PATHS):
+                continue
+            for lineno, fn in scan.prints:
+                seg = sf.segment(fn) if fn is not None else sf.source
+                if GATE_EVIDENCE in seg:
+                    continue
+                yield self.violation(
+                    sf, lineno,
+                    "ungated print in library code — gate on "
+                    "utils.logging.is_rank_zero(), publish a bus event, "
+                    "or route through utils.logging")

@@ -1,0 +1,196 @@
+"""APX004 — durable artifacts commit atomically (.tmp + os.replace).
+
+The framework port of ``tools/check_durability.py`` (which remains as a
+thin CLI shim over this rule). A checkpoint or flight-recorder dump
+written with a bare ``open(path, "w")`` / ``np.savez(path)`` can be torn
+by a crash and then loaded (or choked on) at restore — the exact failure
+class ``apex_tpu.resilience`` exists to close. The rule walks the
+package AST for write calls in checkpoint-flavored code and fails unless
+the enclosing function shows the atomic-commit discipline: stage to
+``.tmp`` + publish with ``os.replace``, route through the
+``Filesystem.write_bytes`` seam, or write only to an in-memory buffer.
+
+Scope (kept deliberately narrow to stay false-positive-free):
+
+- files whose path contains ``checkpoint``,
+- the flight recorder (``monitor/flight``) — its crash-time postmortem
+  dump is exactly the artifact a torn write would make worthless,
+- functions whose name contains save/checkpoint/ckpt/manifest/dump
+  anywhere in ``apex_tpu/``.
+
+Sharded-checkpoint modules (``resilience/distributed``) get two stricter
+rules on top — the two-phase commit's whole crash-safety argument rests
+on them: EVERY write (the seam included) must visibly stage into
+``.tmp``, and the publish must go through ``os.replace``
+(``os.rename``/``shutil.move`` are flagged as non-atomic).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Tuple
+
+from ..core import LintContext, Rule, Violation, register
+
+CKPT_NAME_HINTS = ("save", "checkpoint", "ckpt", "manifest", "dump")
+WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb")
+# evidence of the atomic-commit discipline inside a function's source
+SAFE_MARKERS = (".tmp", "os.replace")
+# writes through these are safe by construction (in-memory, or the fs seam)
+SAFE_CALL_HINTS = ("BytesIO", "write_bytes", "StringIO")
+ALLOWED_FUNCS = {"write_bytes"}  # the seam's own implementation
+
+# sharded-checkpoint modules: the stricter ruleset applies
+SHARDED_PATH_HINTS = (os.path.join("resilience", "distributed"),)
+# flight-recorder module: every on-disk dump is a durable artifact
+FLIGHT_PATH_HINTS = (os.path.join("monitor", "flight"),)
+# evidence a sharded write targets the .tmp staging dir
+STAGING_MARKERS = (".tmp", "_TMP_SUFFIX")
+# non-atomic publish calls: (module attr, call name)
+RENAME_CALLS = {("os", "rename"), ("shutil", "move")}
+
+
+def _is_write_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in ("save", "savez",
+                                                   "savez_compressed"):
+        root = f.value
+        if isinstance(root, ast.Name) and root.id in ("np", "numpy"):
+            return True
+    if isinstance(f, ast.Name) and f.id == "open":
+        mode = None
+        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+            mode = node.args[1].value
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        return isinstance(mode, str) and mode in WRITE_MODES
+    return False
+
+
+def _is_seam_write(node: ast.Call) -> bool:
+    """A write through the Filesystem seam (``*.write_bytes(...)``) — safe
+    in ordinary checkpoint code, but in sharded modules it must still
+    target ``.tmp`` staging."""
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr == "write_bytes"
+
+
+def _is_rename_call(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+            and (f.value.id, f.attr) in RENAME_CALLS)
+
+
+def _path_arg_staged(node: ast.Call) -> bool:
+    """True when the write's path argument visibly derives from a staging
+    variable (``tmp``/``staging``) — e.g. ``os.path.join(tmp, name)`` —
+    the strongest static evidence the bytes land inside the staging dir."""
+    if not node.args:
+        return False
+    for sub in ast.walk(node.args[0]):
+        if isinstance(sub, ast.Name) and (
+                "tmp" in sub.id.lower() or "staging" in sub.id.lower()):
+            return True
+    return False
+
+
+def _writes_to_path(node: ast.Call) -> bool:
+    """Distinguish a filesystem write from a serialize-into-buffer: np.save
+    into an ``io.BytesIO`` (a bare buffer Name) is in-memory; a string
+    constant, f-string, concatenation, ``os.path.join(...)`` or a
+    path-flavored variable name is a real destination."""
+    if isinstance(node.func, ast.Name):  # open(...) — arg IS the path
+        return True
+    if not node.args:
+        return False
+    arg = node.args[0]
+    if isinstance(arg, (ast.Constant, ast.JoinedStr, ast.BinOp, ast.Call)):
+        return True
+    if isinstance(arg, ast.Name):
+        return any(h in arg.id.lower()
+                   for h in ("path", "file", "dir", "dst", "target"))
+    return True  # attribute/subscript etc: assume a path, stay strict
+
+
+def check_source(path: str, src: str) -> List[Tuple[int, str]]:
+    """Durability findings for one file's source: ``[(line, message)]``.
+    Shared by the rule below and the ``check_durability.py`` shim."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"unparseable: {e.msg}")]
+    norm = os.path.normpath(path).lower()
+    ckpt_file = "checkpoint" in os.path.basename(path).lower()
+    sharded_file = any(h in norm for h in SHARDED_PATH_HINTS)
+    flight_file = any(h in norm for h in FLIGHT_PATH_HINTS)
+    lines = src.splitlines()
+    violations: List[Tuple[int, str]] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: List[ast.AST] = []
+
+        def visit_FunctionDef(self, node):
+            self.stack.append(node)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            fn = self.stack[-1] if self.stack else None
+            name = fn.name if fn is not None else "<module>"
+            seg = ("\n".join(lines[fn.lineno - 1:fn.end_lineno])
+                   if fn is not None else src)
+            if _is_write_call(node):
+                in_scope = ckpt_file or sharded_file or flight_file or any(
+                    h in name.lower() for h in CKPT_NAME_HINTS)
+                if in_scope and name not in ALLOWED_FUNCS:
+                    safe = (all(m in seg for m in SAFE_MARKERS)
+                            or any(h in seg for h in SAFE_CALL_HINTS))
+                    if not safe:
+                        violations.append((
+                            node.lineno,
+                            f"{name}: non-atomic write on a durable-"
+                            f"artifact path (want .tmp + os.replace, or "
+                            f"the Filesystem.write_bytes seam)"))
+            if sharded_file and (_is_seam_write(node) or (
+                    _is_write_call(node) and _writes_to_path(node))):
+                # sharded rule 1: every write — seam included — must show
+                # the .tmp staging discipline: either its path argument
+                # derives from the staging variable, or the enclosing
+                # function carries the staging markers
+                if not _path_arg_staged(node) and \
+                        not any(m in seg for m in STAGING_MARKERS):
+                    violations.append((
+                        node.lineno,
+                        f"{name}: sharded-checkpoint write outside .tmp "
+                        f"staging (every byte must stage under "
+                        f"<step>.tmp until the rank-0 replace)"))
+            if (sharded_file or ckpt_file) and _is_rename_call(node):
+                # sharded rule 2: the publish is ONE os.replace — rename/
+                # move have non-atomic or copy semantics across filesystems
+                violations.append((
+                    node.lineno,
+                    f"{name}: checkpoint publish must use os.replace "
+                    f"(os.rename/shutil.move are not the atomic commit)"))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return violations
+
+
+@register
+class DurabilityRule(Rule):
+    RULE_ID = "APX004"
+    SUMMARY = ("durable artifacts (checkpoints, flight dumps) commit via "
+               ".tmp staging + one os.replace")
+
+    SCOPE = "apex_tpu"
+
+    def check(self, ctx: LintContext) -> Iterator[Violation]:
+        for sf in ctx.iter_files(under=self.SCOPE):
+            for lineno, msg in check_source(sf.path, sf.source):
+                yield self.violation(sf, lineno, msg)

@@ -1,0 +1,109 @@
+"""APX003 — every literal event name must be registered in the schema.
+
+``apex_tpu.monitor.goodput`` owns THE event-name schema (``STALL_EVENTS``
+| ``COUNTED_EVENTS`` | ``INFO_EVENTS``): an event published under an
+unregistered name reaches no monitoring consumer — the goodput ledger
+drops it, dashboards never chart it, and the flight recorder can't be
+grepped for it. This rule walks the package AST for every call to
+``publish_event`` / ``structured_warning`` whose event argument is a
+string literal and fails on names outside the schema.
+
+The schema tables are read from goodput.py's **AST** (``literal_eval`` on
+the three assignments), not by importing ``apex_tpu`` — the linter must
+run in environments with no jax backend, and a schema file broken enough
+to not literal-eval should fail the lint loudly anyway.
+
+This is the one source of truth for event-name auditing:
+``tests/test_monitor.py::test_repo_wide_event_schema_audit`` delegates
+here instead of keeping its own regex scan.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional, Set
+
+from ..core import LintContext, Rule, Violation, register
+
+PUBLISH_FUNCS = ("publish_event", "structured_warning")
+SCHEMA_PATH = os.path.join("apex_tpu", "monitor", "goodput.py")
+SCHEMA_TABLES = ("STALL_EVENTS", "COUNTED_EVENTS", "INFO_EVENTS")
+
+
+def load_event_schema(root: str) -> Set[str]:
+    """The registered event names, extracted from goodput.py's AST."""
+    path = os.path.join(root, SCHEMA_PATH)
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    names: Set[str] = set()
+    seen = set()
+    for node in tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name) or \
+                target.id not in SCHEMA_TABLES:
+            continue
+        value = ast.literal_eval(node.value)
+        seen.add(target.id)
+        names |= set(value)  # dict → keys; tuple/list → elements
+    missing = set(SCHEMA_TABLES) - seen
+    if missing:
+        raise ValueError(
+            f"{SCHEMA_PATH}: schema table(s) {sorted(missing)} not found "
+            f"as literal assignments — APX003 cannot audit against them")
+    return names
+
+
+def _event_name_arg(node: ast.Call) -> Optional[ast.Constant]:
+    """The literal event-name argument, if this is a publish call."""
+    fname = None
+    if isinstance(node.func, ast.Name):
+        fname = node.func.id
+    elif isinstance(node.func, ast.Attribute):
+        fname = node.func.attr
+    if fname not in PUBLISH_FUNCS:
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "event" and isinstance(kw.value, ast.Constant) and \
+                isinstance(kw.value.value, str):
+            return kw.value
+    return None
+
+
+@register
+class EventSchemaRule(Rule):
+    RULE_ID = "APX003"
+    SUMMARY = ("literal publish_event/structured_warning names must be "
+               "registered in apex_tpu.monitor.goodput's event schema")
+
+    # the schema's own module publishes nothing; scope is the package
+    SCOPE = "apex_tpu"
+
+    def check(self, ctx: LintContext) -> Iterator[Violation]:
+        try:
+            schema = load_event_schema(ctx.root)
+        except (OSError, ValueError, SyntaxError) as e:
+            # no schema file (fixture trees) → nothing to audit against
+            for sf in ctx.iter_files(under=self.SCOPE):
+                if sf.path == SCHEMA_PATH.replace("/", os.sep):
+                    yield self.violation(sf, 1, f"schema unreadable: {e}")
+            return
+        for sf in ctx.iter_files(under=self.SCOPE):
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                arg = _event_name_arg(node)
+                if arg is not None and arg.value not in schema:
+                    yield self.violation(
+                        sf, node.lineno,
+                        f"event {arg.value!r} is not registered in the "
+                        f"goodput schema (add it to STALL_EVENTS/"
+                        f"COUNTED_EVENTS/INFO_EVENTS in "
+                        f"apex_tpu/monitor/goodput.py)")

@@ -1,0 +1,306 @@
+"""APX002 — lock discipline: guarded state is not RMW'd lock-free.
+
+The scheduler/bus/watchdog/flight-recorder state is mutated from several
+threads (heartbeat threads, bus subscribers, a deployment calling
+``ServeScheduler.abort`` mid-run) behind ad-hoc locks, and the PR-6
+``ChromeTraceWriter`` framing race was caught only in review. This rule
+makes the discipline mechanical:
+
+For every class (or module) that owns locks — attributes/globals
+assigned ``threading.Lock()`` / ``threading.RLock()`` — the rule
+collects the names (attributes/globals) **ever mutated inside a** ``with
+self._lock:`` **block**, remembering *which* lock. Those are the
+*guarded* names: somebody decided they need a lock, so every
+read-modify-write must hold **that** lock. Flagged:
+
+- a RMW of a guarded name with **no** lock held:
+  ``self.x += 1`` / ``x += 1`` (augmented assignment),
+  ``self.x[k] = v`` / ``del self.x[k]`` (container element writes),
+  ``self.x.append(...)`` and the other mutating container methods,
+  ``self.x = f(self.x)`` (an assignment whose RHS reads the same name);
+- a RMW of a guarded name under a **different** lock than the one(s)
+  guarding it elsewhere (two locks "protecting" the same name protect
+  nothing).
+
+Plain rebinding (``self.x = fresh_value``) stays legal outside the lock
+— it is atomic under the GIL and the idiom for publishing a new
+snapshot. ``__init__`` is exempt (the object is not shared yet). Helper
+methods entered with the lock already held declare it with a marker
+comment in their body — ``# caller holds self._lock`` — which the rule
+treats as holding that lock (the existing ``ChromeTraceWriter._emit``
+idiom; a marker naming no known lock counts as holding all of them).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from ..core import LintContext, Rule, SourceFile, Violation, register
+
+MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear",
+})
+HOLDS_MARKER_RE = re.compile(r"caller holds\s+(?:self\.)?(\w+)")
+HOLDS_MARKER = "caller holds"
+LOCK_CTORS = ("Lock", "RLock")
+EXEMPT_METHODS = ("__init__",)
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in LOCK_CTORS
+    return isinstance(f, ast.Attribute) and f.attr in LOCK_CTORS and \
+        isinstance(f.value, ast.Name) and f.value.id == "threading"
+
+
+def _lock_assign_targets(stmt: ast.AST) -> List[ast.AST]:
+    """Assignment targets when ``stmt`` binds a Lock()/RLock() — covers
+    plain AND annotated assignment (``self._lock: Lock = Lock()``), so a
+    type annotation cannot silently blind the rule."""
+    if isinstance(stmt, ast.Assign) and _is_lock_ctor(stmt.value):
+        return list(stmt.targets)
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None and \
+            _is_lock_ctor(stmt.value):
+        return [stmt.target]
+    return []
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+@dataclasses.dataclass
+class _Mutation:
+    name: str          # attribute (class mode) or global (module mode)
+    lineno: int
+    rmw: bool          # read-modify-write (vs. plain rebinding)
+    held: FrozenSet[str]   # lock names held at the mutation site
+    func: str          # enclosing method/function name
+    desc: str
+
+    @property
+    def locked(self) -> bool:
+        return bool(self.held)
+
+
+class _ScopeWalker(ast.NodeVisitor):
+    """Walk one function body tracking which locks are held and
+    collecting mutations of self-attrs (class mode) or known globals
+    (module mode)."""
+
+    def __init__(self, sf: SourceFile, locks: Set[str], func: ast.AST,
+                 func_name: str, globals_: Optional[Set[str]] = None):
+        self.sf = sf
+        self.locks = locks
+        self.func_name = func_name
+        self.globals = globals_     # None → class mode (track self.attr)
+        self.held: List[str] = []
+        # a "caller holds <lock>" marker makes the whole body hold that
+        # lock (an unrecognized lock name degrades to holding all — the
+        # marker is evidence of intent, not grounds for a false positive)
+        seg = sf.segment(func)
+        if HOLDS_MARKER in seg:
+            named = [m for m in HOLDS_MARKER_RE.findall(seg)
+                     if m in locks]
+            self.held.extend(named if named else sorted(locks))
+        self.mutations: List[_Mutation] = []
+
+    # ---- lock tracking --------------------------------------------------
+    def _lock_name(self, node: ast.AST) -> Optional[str]:
+        if self.globals is None:
+            attr = _self_attr(node)
+            return attr if attr is not None and attr in self.locks \
+                else None
+        if isinstance(node, ast.Name) and node.id in self.locks:
+            return node.id
+        return None
+
+    def visit_With(self, node: ast.With):
+        entered = [n for n in (self._lock_name(item.context_expr)
+                               for item in node.items) if n is not None]
+        self.held.extend(entered)
+        for stmt in node.body:
+            self.visit(stmt)
+        if entered:
+            del self.held[-len(entered):]
+
+    def visit_FunctionDef(self, node):
+        # nested defs inherit the lexical locked state at their definition
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # ---- mutation collection -------------------------------------------
+    def _target_name(self, node: ast.AST) -> Optional[str]:
+        """The tracked name a store/mutation targets, or None."""
+        if self.globals is None:
+            return _self_attr(node)
+        if isinstance(node, ast.Name) and node.id in self.globals:
+            return node.id
+        return None
+
+    def _reads(self, expr: ast.AST, name: str) -> bool:
+        for sub in ast.walk(expr):
+            if self._target_name(sub) == name:
+                return True
+        return False
+
+    def _record(self, name: str, lineno: int, rmw: bool, desc: str) -> None:
+        self.mutations.append(_Mutation(
+            name, lineno, rmw, frozenset(self.held), self.func_name, desc))
+
+    def visit_Assign(self, node: ast.Assign):
+        for t in node.targets:
+            targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for tgt in targets:
+                name = self._target_name(tgt)
+                if name is not None:
+                    rmw = self._reads(node.value, name)
+                    self._record(name, node.lineno, rmw,
+                                 "assignment reading the same attribute"
+                                 if rmw else "rebinding")
+                elif isinstance(tgt, ast.Subscript):
+                    name = self._target_name(tgt.value)
+                    if name is not None:
+                        self._record(name, node.lineno, True,
+                                     "container element write")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        name = self._target_name(node.target)
+        if name is not None:
+            self._record(name, node.lineno, True, "augmented assignment")
+        elif isinstance(node.target, ast.Subscript):
+            name = self._target_name(node.target.value)
+            if name is not None:
+                self._record(name, node.lineno, True,
+                             "container element write")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for t in node.targets:
+            if isinstance(t, ast.Subscript):
+                name = self._target_name(t.value)
+                if name is not None:
+                    self._record(name, node.lineno, True,
+                                 "container element delete")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+            name = self._target_name(f.value)
+            if name is not None:
+                self._record(name, node.lineno, True, f".{f.attr}()")
+        self.generic_visit(node)
+
+
+def _analyze(sf: SourceFile, scope_desc: str, locks: Set[str],
+             funcs: List[Tuple[str, ast.AST]],
+             globals_: Optional[Set[str]]) -> Iterator[Tuple[int, str]]:
+    """Shared class/module analysis: collect mutations per function, form
+    the per-lock guarded sets, flag lock-free or wrong-lock RMW."""
+    all_mut: List[_Mutation] = []
+    for fname, fnode in funcs:
+        w = _ScopeWalker(sf, locks, fnode, fname, globals_)
+        for stmt in fnode.body:
+            w.visit(stmt)
+        all_mut.extend(w.mutations)
+    considered = [m for m in all_mut
+                  if m.name not in locks and m.func not in EXEMPT_METHODS]
+    # name → every lock set it was mutated under (the guard evidence)
+    guard_sets = {}
+    for m in considered:
+        if m.locked:
+            guard_sets.setdefault(m.name, []).append(m.held)
+    lock_ref = ("" if globals_ is not None else "self.") + sorted(locks)[0]
+    for m in considered:
+        if not m.rmw or m.name not in guard_sets:
+            continue
+        if not m.locked:
+            guards = sorted(set().union(*guard_sets[m.name]))
+            yield (m.lineno,
+                   f"{scope_desc}.{m.func}: lock-free {m.desc} of "
+                   f"{m.name!r}, which is elsewhere mutated under "
+                   f"{', '.join(guards)} — take the lock (or mark the "
+                   f"helper `# {HOLDS_MARKER} {lock_ref}`)")
+        elif any(not (m.held & other) for other in guard_sets[m.name]):
+            # held a lock — but a DIFFERENT one than another mutation of
+            # the same name holds: the two sites do not exclude each other
+            others = sorted(set().union(
+                *(o for o in guard_sets[m.name] if not (m.held & o))))
+            yield (m.lineno,
+                   f"{scope_desc}.{m.func}: {m.desc} of {m.name!r} under "
+                   f"{', '.join(sorted(m.held))}, but it is elsewhere "
+                   f"mutated under {', '.join(others)} — two locks "
+                   f"guarding one name exclude nothing; pick one")
+
+
+@register
+class LockDisciplineRule(Rule):
+    RULE_ID = "APX002"
+    SUMMARY = ("state mutated under a lock may not be read-modify-"
+               "written outside it (or under a different lock)")
+
+    def check(self, ctx: LintContext) -> Iterator[Violation]:
+        for sf in ctx.files:
+            if sf.tree is None:
+                continue
+            # ---- class scopes ----------------------------------------
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                methods = [(n.name, n) for n in node.body
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+                locks: Set[str] = set()
+                for stmt in node.body:  # class-attr locks: _lock = Lock()
+                    for t in _lock_assign_targets(stmt):
+                        if isinstance(t, ast.Name):
+                            locks.add(t.id)
+                for _, meth in methods:
+                    for sub in ast.walk(meth):
+                        for t in _lock_assign_targets(sub):
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                locks.add(attr)
+                if not locks:
+                    continue
+                for lineno, msg in _analyze(sf, node.name, locks,
+                                            methods, None):
+                    yield self.violation(sf, lineno, msg)
+            # ---- module scope ----------------------------------------
+            assert isinstance(sf.tree, ast.Module)
+            mod_locks: Set[str] = set()
+            mod_globals: Set[str] = set()
+            for stmt in sf.tree.body:
+                lock_targets = _lock_assign_targets(stmt)
+                if lock_targets:
+                    mod_locks |= {t.id for t in lock_targets
+                                  if isinstance(t, ast.Name)}
+                elif isinstance(stmt, ast.Assign):
+                    mod_globals |= {t.id for t in stmt.targets
+                                    if isinstance(t, ast.Name)}
+                elif isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    mod_globals.add(stmt.target.id)
+            if not mod_locks:
+                continue
+            funcs = [(n.name, n) for n in sf.tree.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))]
+            for lineno, msg in _analyze(sf, sf.path, mod_locks, funcs,
+                                        mod_globals):
+                yield self.violation(sf, lineno, msg)

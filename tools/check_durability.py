@@ -1,209 +1,51 @@
 #!/usr/bin/env python
-"""Static durability check: no non-atomic writes on checkpoint paths.
+"""Static durability check — thin shim over apexlint rule APX004.
 
-A checkpoint written with a bare ``open(path, "w")`` / ``np.savez(path)``
-can be torn by a crash and then loaded (or choked on) at restore — the
-exact failure class ``apex_tpu.resilience`` exists to close. This check
-greps the package AST for write calls in checkpoint-flavored code and
-fails unless the enclosing function shows the atomic-commit discipline:
-stage to ``.tmp`` + publish with ``os.replace``, or route through the
-``Filesystem.write_bytes`` seam (whose sole implementation follows it),
-or write only to an in-memory buffer.
+The checker itself now lives in the reusable lint framework
+(``tools/apexlint/rules/durability.py``, rule **APX004**) together with
+the other repo invariants; this script keeps the original CLI contract
+for existing callers and docs:
 
-Scope (kept deliberately narrow to stay false-positive-free):
-- files whose path contains ``checkpoint``,
-- the flight recorder (``monitor/flight``) — its crash-time postmortem
-  dump is exactly the artifact a torn write would make worthless, so it
-  follows the same ``.tmp`` + ``os.replace`` rule, and
-- functions whose name contains save/checkpoint/ckpt/manifest/dump
-  anywhere in ``apex_tpu/``.
+- ``python tools/check_durability.py`` from the repo root,
+- exit 0 clean / 1 on violations (listed one per line on stderr),
+- ``_check_file(path)`` stays importable for tests.
 
-Sharded-checkpoint paths (``resilience/distributed``) get two stricter
-rules on top — the two-phase commit's whole crash-safety argument rests on
-them:
-- EVERY write (the ``Filesystem.write_bytes`` seam included) must sit in a
-  function that visibly stages into ``.tmp`` — a write landing outside
-  staging would be observable before the commit point;
-- the publish must go through ``replace`` — ``os.rename``/``shutil.move``
-  anywhere in checkpoint-flavored code is flagged (non-atomic or
-  cross-filesystem-copy semantics).
-
-Exit status: 0 clean, 1 on violations (listed one per line). Run as
-``python tools/check_durability.py`` from the repo root; the tier-1 suite
-runs it (tests/test_resilience.py) so new violations fail CI.
+Prefer the full linter: ``apex-tpu-lint`` or
+``python -m tools.apexlint`` (``--rules APX004`` for just this rule).
+See docs/static-analysis.md.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(ROOT, "apex_tpu")
+if ROOT not in sys.path:  # script execution: make tools.apexlint importable
+    sys.path.insert(0, ROOT)
 
-CKPT_NAME_HINTS = ("save", "checkpoint", "ckpt", "manifest", "dump")
-WRITE_MODES = ("w", "wb", "w+", "wb+", "x", "xb")
-# evidence of the atomic-commit discipline inside a function's source
-SAFE_MARKERS = (".tmp", "os.replace")
-# writes through these are safe by construction (in-memory, or the fs seam)
-SAFE_CALL_HINTS = ("BytesIO", "write_bytes", "StringIO")
-ALLOWED_FUNCS = {"write_bytes"}  # the seam's own implementation
-
-# sharded-checkpoint modules: the stricter ruleset applies
-SHARDED_PATH_HINTS = (os.path.join("resilience", "distributed"),)
-# flight-recorder module: every on-disk dump is a durable artifact
-FLIGHT_PATH_HINTS = (os.path.join("monitor", "flight"),)
-# evidence a sharded write targets the .tmp staging dir
-STAGING_MARKERS = (".tmp", "_TMP_SUFFIX")
-# non-atomic publish calls: (module attr, call name)
-RENAME_CALLS = {("os", "rename"), ("shutil", "move")}
-
-
-def _is_write_call(node: ast.Call) -> bool:
-    f = node.func
-    if isinstance(f, ast.Attribute) and f.attr in ("save", "savez",
-                                                   "savez_compressed"):
-        root = f.value
-        if isinstance(root, ast.Name) and root.id in ("np", "numpy"):
-            return True
-    if isinstance(f, ast.Name) and f.id == "open":
-        mode = None
-        if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
-            mode = node.args[1].value
-        for kw in node.keywords:
-            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
-                mode = kw.value.value
-        return isinstance(mode, str) and mode in WRITE_MODES
-    return False
-
-
-def _is_seam_write(node: ast.Call) -> bool:
-    """A write through the Filesystem seam (``*.write_bytes(...)``) — safe
-    in ordinary checkpoint code, but in sharded modules it must still
-    target ``.tmp`` staging."""
-    return isinstance(node.func, ast.Attribute) and \
-        node.func.attr == "write_bytes"
-
-
-def _is_rename_call(node: ast.Call) -> bool:
-    f = node.func
-    return (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
-            and (f.value.id, f.attr) in RENAME_CALLS)
-
-
-def _path_arg_staged(node: ast.Call) -> bool:
-    """True when the write's path argument visibly derives from a staging
-    variable (``tmp``/``staging``) — e.g. ``os.path.join(tmp, name)`` —
-    the strongest static evidence the bytes land inside the staging dir."""
-    if not node.args:
-        return False
-    for sub in ast.walk(node.args[0]):
-        if isinstance(sub, ast.Name) and (
-                "tmp" in sub.id.lower() or "staging" in sub.id.lower()):
-            return True
-    return False
-
-
-def _writes_to_path(node: ast.Call) -> bool:
-    """Distinguish a filesystem write from a serialize-into-buffer: np.save
-    into an ``io.BytesIO`` (a bare buffer Name) is in-memory; a string
-    constant, f-string, concatenation, ``os.path.join(...)`` or a
-    path-flavored variable name is a real destination."""
-    if isinstance(node.func, ast.Name):  # open(...) — arg IS the path
-        return True
-    if not node.args:
-        return False
-    arg = node.args[0]
-    if isinstance(arg, (ast.Constant, ast.JoinedStr, ast.BinOp, ast.Call)):
-        return True
-    if isinstance(arg, ast.Name):
-        return any(h in arg.id.lower()
-                   for h in ("path", "file", "dir", "dst", "target"))
-    return True  # attribute/subscript etc: assume a path, stay strict
+from tools.apexlint.rules.durability import check_source  # noqa: E402
 
 
 def _check_file(path: str) -> List[Tuple[int, str]]:
-    src = open(path).read()
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"unparseable: {e.msg}")]
-    norm = os.path.normpath(path).lower()
-    ckpt_file = "checkpoint" in os.path.basename(path).lower()
-    sharded_file = any(h in norm for h in SHARDED_PATH_HINTS)
-    flight_file = any(h in norm for h in FLIGHT_PATH_HINTS)
-    lines = src.splitlines()
-    violations: List[Tuple[int, str]] = []
-
-    class V(ast.NodeVisitor):
-        def __init__(self):
-            self.stack: List[ast.AST] = []
-
-        def visit_FunctionDef(self, node):
-            self.stack.append(node)
-            self.generic_visit(node)
-            self.stack.pop()
-
-        visit_AsyncFunctionDef = visit_FunctionDef
-
-        def visit_Call(self, node):
-            fn = self.stack[-1] if self.stack else None
-            name = fn.name if fn is not None else "<module>"
-            seg = ("\n".join(lines[fn.lineno - 1:fn.end_lineno])
-                   if fn is not None else src)
-            if _is_write_call(node):
-                in_scope = ckpt_file or sharded_file or flight_file or any(
-                    h in name.lower() for h in CKPT_NAME_HINTS)
-                if in_scope and name not in ALLOWED_FUNCS:
-                    safe = (all(m in seg for m in SAFE_MARKERS)
-                            or any(h in seg for h in SAFE_CALL_HINTS))
-                    if not safe:
-                        violations.append((
-                            node.lineno,
-                            f"{name}: non-atomic write on a durable-"
-                            f"artifact path (want .tmp + os.replace, or "
-                            f"the Filesystem.write_bytes seam)"))
-            if sharded_file and (_is_seam_write(node) or (
-                    _is_write_call(node) and _writes_to_path(node))):
-                # sharded rule 1: every write — seam included — must show
-                # the .tmp staging discipline: either its path argument
-                # derives from the staging variable, or the enclosing
-                # function carries the staging markers
-                if not _path_arg_staged(node) and \
-                        not any(m in seg for m in STAGING_MARKERS):
-                    violations.append((
-                        node.lineno,
-                        f"{name}: sharded-checkpoint write outside .tmp "
-                        f"staging (every byte must stage under "
-                        f"<step>.tmp until the rank-0 replace)"))
-            if (sharded_file or ckpt_file) and _is_rename_call(node):
-                # sharded rule 2: the publish is ONE os.replace — rename/
-                # move have non-atomic or copy semantics across filesystems
-                violations.append((
-                    node.lineno,
-                    f"{name}: checkpoint publish must use os.replace "
-                    f"(os.rename/shutil.move are not the atomic commit)"))
-            self.generic_visit(node)
-
-    V().visit(tree)
-    return violations
+    """``[(lineno, message)]`` durability findings for one file."""
+    with open(path, encoding="utf-8") as f:
+        return check_source(path, f.read())
 
 
 def main() -> int:
-    bad = []
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            for lineno, msg in _check_file(path):
-                bad.append(f"{os.path.relpath(path, ROOT)}:{lineno}: {msg}")
-    if bad:
+    from tools.apexlint.core import run_lint
+
+    active, _suppressed, _ctx = run_lint(
+        root=ROOT, paths=[os.path.join(ROOT, "apex_tpu")],
+        only=["APX004"])
+    if active:
+        # the original tool's output shape: header + one violation per
+        # line on STDERR (log pipelines grep that stream)
         print("durability check FAILED:", file=sys.stderr)
-        for b in bad:
-            print("  " + b, file=sys.stderr)
+        for v in active:
+            print(f"  {v.path}:{v.line}: {v.message}", file=sys.stderr)
         return 1
     print("durability check OK")
     return 0
