@@ -241,12 +241,18 @@ def _load_bench_module():
 
 
 def _serve_bench(steps: int, num_slots: int = 4,
-                 emit_baseline: "str | None" = None) -> None:
+                 emit_baseline: "str | None" = None,
+                 deadline_ms: "float | None" = None,
+                 max_queue: "int | None" = None,
+                 shed_policy: str = "reject-newest") -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
     gate (``tools/check_regression.py CURRENT --suite BASELINE --kernels
-    serve_decode``). Latency metrics are lower-is-better; the gate knows.
+    serve_decode``). Latency metrics are lower-is-better; the gate knows —
+    as are the overload SLO fields (``rejected``, ``deadline_exceeded``,
+    ``shed_rate``) the entry carries when ``--deadline-ms``/``--max-queue``
+    shape the workload.
     """
     import dataclasses
     import json
@@ -273,7 +279,13 @@ def _serve_bench(steps: int, num_slots: int = 4,
     prompt_len = 8
     engine.aot_compile([prompt_len])  # compiles land before the clock
     rng = np.random.RandomState(0)
-    sched = ServeScheduler(engine)
+    admission = None
+    if max_queue is not None:
+        from apex_tpu.serve.resilience import AdmissionController
+
+        admission = AdmissionController(max_queue=max_queue,
+                                        shed_policy=shed_policy)
+    sched = ServeScheduler(engine, admission=admission)
     # enough requests to keep every slot busy and exercise backfill
     n_requests = max(2 * num_slots, (steps * num_slots) // 8 + 1)
     for i in range(n_requests):
@@ -281,7 +293,7 @@ def _serve_bench(steps: int, num_slots: int = 4,
             request_id=f"bench-{i}",
             tokens=[int(t) for t in rng.randint(0, cfg.vocab_size,
                                                 prompt_len)],
-            max_new_tokens=8))
+            max_new_tokens=8, deadline_ms=deadline_ms))
     t0 = time.perf_counter()
     stats = sched.run(max_steps=steps)
     wall = time.perf_counter() - t0
@@ -297,14 +309,25 @@ def _serve_bench(steps: int, num_slots: int = 4,
             "value": s["tokens_per_s"], "unit": "tokens_per_s",
             "p50_ms": s["p50_step_ms"], "p99_ms": s["p99_step_ms"],
             "ttft_ms": s["ttft_p50_ms"],
+            # overload SLO fields (lower-is-better; check_regression
+            # knows) — zero on the default unbounded/no-deadline workload
+            "rejected": s["rejected"],
+            "deadline_exceeded": s["deadline_exceeded"],
+            "shed_rate": s["shed_rate"],
             "bench_wall_s": round(wall, 3),
             # workload config nested as a dict: check_regression lifts
             # only numeric scalars, so a capture with different
             # --steps/--serve-slots than the baseline gates on PERF
             # fields alone, not on its own configuration
+            # the overload knobs ride along so a capture whose SLO
+            # counters were shaped by a different config is identifiable
+            # (nested dict: never lifted into the gated metrics)
             "workload": {"steps": s["decode_steps"],
                          "new_tokens": s["new_tokens"],
-                         "slots": num_slots},
+                         "slots": num_slots,
+                         "deadline_ms": deadline_ms,
+                         "max_queue": max_queue,
+                         "shed_policy": shed_policy},
             # a subset capture, not the full committed suite
             "complete": False,
         },
@@ -400,6 +423,17 @@ def main() -> None:
                             help="decode steps to run (the workload "
                                  "keeps slots busy with backfill)")
             ap.add_argument("--serve-slots", type=int, default=4)
+            ap.add_argument("--deadline-ms", type=float, default=None,
+                            help="per-request latency budget; misses "
+                                 "show up as deadline_exceeded in the "
+                                 "serve_decode entry")
+            ap.add_argument("--max-queue", type=int, default=None,
+                            help="bound the admission backlog; overflow "
+                                 "is shed per --shed-policy and counted "
+                                 "in rejected/shed_rate")
+            ap.add_argument("--shed-policy", default="reject-newest",
+                            choices=["reject-newest", "shed-oldest",
+                                     "priority"])
             ap.add_argument("--emit-baseline", nargs="?",
                             const="BENCH_BASELINE_SERVE.json",
                             default=None,
@@ -407,7 +441,10 @@ def main() -> None:
                                  "(default BENCH_BASELINE_SERVE.json)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
-                         args.emit_baseline)
+                         args.emit_baseline,
+                         deadline_ms=args.deadline_ms,
+                         max_queue=args.max_queue,
+                         shed_policy=args.shed_policy)
         elif has_telemetry:
             import argparse
 

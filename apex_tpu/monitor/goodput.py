@@ -44,6 +44,15 @@ STALL_EVENTS = {
     # serving: time a request sat in the admission queue because no cache
     # slot was free — capacity lost to queueing, not to compute
     "serve_queue_wait": "serve_queue_wait",
+    # serving overload/failure semantics (PR 8): a deadline miss charges
+    # the whole submit-to-expiry span (the client gave up; everything
+    # computed for it is discarded), a shed/rejected request charges the
+    # queue time it wasted before the shed policy chose it. NOTE serving
+    # causes can overlap each other and decode wall time (many requests
+    # wait concurrently) — they attribute lost capacity, they do not
+    # partition the wall clock the way training causes do.
+    "serve_deadline_exceeded": "serve_deadline_exceeded",
+    "serve_request_rejected": "serve_rejected",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
@@ -58,6 +67,7 @@ COUNTED_EVENTS = (
     "preemption_requested", "bench_preempted",
     "serve_request_admitted", "serve_request_completed",
     "serve_request_evicted", "serve_decode_step",
+    "serve_engine_restart", "serve_degraded_mode",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

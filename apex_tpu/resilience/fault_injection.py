@@ -24,6 +24,16 @@ Production failure modes, reproduced on a laptop with a seed:
   mid-stream request cancellation that the serve scheduler
   (:class:`~apex_tpu.serve.scheduler.ServeScheduler`) consumes before the
   given decode step — a client disconnect at a replayable point.
+- **Serving chaos** — ``crash_on_decode_step(at_step)`` raises
+  :class:`SimulatedCrash` the instant the scheduler would issue that
+  decode step (a fatal XLA/runtime error mid-tick — the warm-restart
+  path's trigger), ``latency_spike(at_step, seconds)`` stalls one tick
+  (a straggling device, a host hiccup — what drives deadline expiry
+  deterministically), and ``queue_storm(at_step, count, ...)`` injects a
+  seeded burst of synthetic requests through the normal submit path (the
+  admission-control/load-shedding workload). The tier-1 chaos suite runs
+  all three under one schedule and asserts every submitted request
+  reaches exactly one terminal status.
 - **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
   window of steps whose gradients ``poison_grads`` fills with NaN/Inf
   (choice seeded), reproducing the overflow storms that collapse a dynamic
@@ -116,6 +126,10 @@ class FaultInjector:
         self._crash_replace_patterns: List[re.Pattern] = []
         self._stragglers: List[List[Any]] = []  # [rank, name|None, delay_s]
         self._serve_aborts: Dict[int, List[Any]] = {}  # step -> request ids
+        self._decode_crashes: Dict[int, int] = {}      # step -> remaining
+        self._latency_spikes: Dict[int, float] = {}    # step -> seconds
+        self._storms: Dict[int, List[Dict[str, Any]]] = {}  # step -> specs
+        self._storm_serial = 0
 
     # ---- filesystem faults ---------------------------------------------
     def filesystem(self) -> Filesystem:
@@ -242,6 +256,77 @@ class FaultInjector:
         """Request ids scheduled to abort before decode step ``step``
         (consumed: each schedule fires once)."""
         return self._serve_aborts.pop(int(step), [])
+
+    # ---- serving: decode crashes / latency spikes / queue storms --------
+    def crash_on_decode_step(self, at_step: int,
+                             times: int = 1) -> "FaultInjector":
+        """Raise :class:`SimulatedCrash` when the scheduler issues the
+        decode step after ``at_step`` completed steps — a fatal XLA or
+        runtime error inside the jitted step, at an exact replayable
+        tick. ``times > 1`` re-fires on the same tick after each warm
+        restart (the snapshot rolls ``decode_steps`` back, so the
+        recovered scheduler reaches the same count again) — how the
+        restart-budget-exhaustion path is driven."""
+        self._decode_crashes[int(at_step)] = \
+            self._decode_crashes.get(int(at_step), 0) + max(1, int(times))
+        return self
+
+    def maybe_crash_decode(self, step: int) -> None:
+        """Consumed by the scheduler just before the decode call; raises
+        when a crash is scheduled for ``step`` (each scheduled firing
+        consumed exactly once)."""
+        left = self._decode_crashes.get(int(step), 0)
+        if left <= 0:
+            return
+        if left == 1:
+            self._decode_crashes.pop(int(step), None)
+        else:
+            self._decode_crashes[int(step)] = left - 1
+        raise SimulatedCrash(
+            f"injected fatal decode-step error at step {step}")
+
+    def latency_spike(self, at_step: int,
+                      seconds: float) -> "FaultInjector":
+        """Stall the decode tick after ``at_step`` completed steps by
+        ``seconds`` (host sleep before the compiled call) — a straggling
+        device or host hiccup; the deterministic way to push a request
+        past its ``deadline_ms``. One-shot."""
+        self._latency_spikes[int(at_step)] = float(seconds)
+        return self
+
+    def latency_spike_due(self, step: int) -> float:
+        """Seconds the scheduler should stall this tick (consumed)."""
+        return self._latency_spikes.pop(int(step), 0.0)
+
+    def queue_storm(self, at_step: int, count: int, *,
+                    prompt_len: int = 6, vocab: int = 97,
+                    max_new_tokens: int = 4,
+                    deadline_ms: Optional[float] = None,
+                    priority: int = 0) -> "FaultInjector":
+        """Schedule a burst of ``count`` synthetic requests (seeded token
+        content, ids ``storm-<n>``) that the scheduler submits through
+        its NORMAL admission path before the given decode step — the
+        workload that drives bounded-queue rejection, shed policies, and
+        degraded mode, deterministically."""
+        specs = self._storms.setdefault(int(at_step), [])
+        for _ in range(int(count)):
+            spec: Dict[str, Any] = {
+                "request_id": f"storm-{self._storm_serial}",
+                "tokens": [self.rng.randrange(int(vocab))
+                           for _ in range(int(prompt_len))],
+                "max_new_tokens": int(max_new_tokens),
+                "priority": int(priority),
+            }
+            if deadline_ms is not None:
+                spec["deadline_ms"] = float(deadline_ms)
+            specs.append(spec)
+            self._storm_serial += 1
+        return self
+
+    def serve_storm_due(self, step: int) -> List[Dict[str, Any]]:
+        """Request-constructor kwargs for the burst scheduled before
+        decode step ``step`` (consumed)."""
+        return self._storms.pop(int(step), [])
 
     # ---- preemption -----------------------------------------------------
     def fire_preemption(self, sig: int = signal.SIGTERM) -> None:

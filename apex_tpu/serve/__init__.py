@@ -23,19 +23,33 @@ one invariant:
   queue, slot assignment, per-request EOS/max-token termination, eviction
   and backfill between decode steps, with TTFT/latency/throughput
   accounting and ``serve_*`` events on the telemetry bus.
+- :mod:`~apex_tpu.serve.resilience` — production failure semantics:
+  bounded-queue admission with pluggable load shedding
+  (:class:`AdmissionController`), graceful degradation under sustained
+  overload, the per-tick :class:`TickJournal`, and the
+  :class:`ServeSupervisor` warm-restart loop (a fatal tick exception
+  rolls back to the last journaled tick; every submitted request reaches
+  exactly one terminal status). Per-request deadlines live on
+  :class:`Request` (``deadline_ms``) and are swept every tick.
 - :mod:`~apex_tpu.serve.cli` — ``apex-tpu-serve``: load a model config,
   run a scripted or stdin request stream, print per-request stats.
 
-See docs/serving.md for the architecture and the slot lifecycle.
+See docs/serving.md for the architecture, the slot lifecycle, and the
+overload/failure contracts.
 """
 
 from apex_tpu.serve.engine import Engine, EngineConfig  # noqa: F401
 from apex_tpu.serve.kv_cache import (KVCache, evict_slots,  # noqa: F401
                                      init_cache, write_token)
+from apex_tpu.serve.resilience import (SHED_POLICIES,  # noqa: F401
+                                       AdmissionController,
+                                       ServeSupervisor, TickJournal)
 from apex_tpu.serve.scheduler import (Request, ServeScheduler,  # noqa: F401
                                       ServeStats)
 
 __all__ = [
     "Engine", "EngineConfig", "KVCache", "init_cache", "write_token",
     "evict_slots", "Request", "ServeScheduler", "ServeStats",
+    "AdmissionController", "TickJournal", "ServeSupervisor",
+    "SHED_POLICIES",
 ]

@@ -9,11 +9,21 @@ Two request sources:
   ids (the engine speaks token ids; tokenization lives with the caller).
 
 Per request, one JSON line: ``{request_id, state, finish_reason,
-prompt_tokens, new_tokens, generated, ttft_s, latency_s, tokens_per_s}``;
-the final line is the aggregate summary (tokens/s, p50/p99 per-step
-latency, TTFT). ``serve_*`` lifecycle events ride the telemetry bus —
+prompt_tokens, new_tokens, generated, ttft_s, latency_s, tokens_per_s}``
+(load-shed requests additionally carry ``"retriable": true`` — a healthy
+or less-loaded replica can serve them); the final line is the aggregate
+summary (tokens/s, p50/p99 per-step latency, TTFT, plus the SLO fields
+``rejected`` / ``deadline_exceeded`` / ``shed_rate`` / ``restarts``).
+``serve_*`` lifecycle events ride the telemetry bus —
 ``--telemetry-jsonl PATH`` mirrors them (and nothing else crosses the
 host boundary per step beyond the sampled tokens).
+
+Production failure semantics (docs/serving.md "Overload and failure
+semantics"): ``--deadline-ms`` bounds per-request latency,
+``--max-queue`` + ``--shed-policy`` bound the backlog with explicit
+rejection, ``--max-restarts N`` arms the tick journal + warm-restart
+supervisor so a fatal tick exception recovers instead of killing every
+in-flight request.
 
 Example::
 
@@ -55,6 +65,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--eos-id", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request latency budget from submit; expired "
+                         "requests (queued or running) terminate with "
+                         "finish_reason=deadline")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bound the admission backlog; overflow is shed "
+                         "per --shed-policy as a terminal, retriable "
+                         "rejection (default: unbounded)")
+    ap.add_argument("--shed-policy", default="reject-newest",
+                    choices=["reject-newest", "shed-oldest", "priority"],
+                    help="who pays when the queue is full")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="warm restarts to attempt after a fatal tick "
+                         "exception (tick journal + recovery; 0 = fail "
+                         "fast, the pre-PR-8 behavior)")
     ap.add_argument("--requests", type=int, default=4,
                     help="scripted request count (ignored with --stdin)")
     ap.add_argument("--prompt-len", type=int, default=8,
@@ -159,14 +184,32 @@ def main(argv: Optional[List[str]] = None) -> int:
         # static hbm_snapshot, which the sinks above must see
         engine.aot_compile([max(len(p) for p in prompts)])
 
+    admission = journal = None
+    if args.max_queue is not None:
+        from apex_tpu.serve.resilience import AdmissionController
+
+        admission = AdmissionController(max_queue=args.max_queue,
+                                        shed_policy=args.shed_policy)
+    if args.max_restarts > 0:
+        from apex_tpu.serve.resilience import TickJournal
+
+        journal = TickJournal()
     sched = ServeScheduler(engine, tracer=tracer, flight_recorder=flight,
-                           memory_accountant=mem)
+                           memory_accountant=mem, admission=admission,
+                           journal=journal)
     for i, toks in enumerate(prompts):
         sched.submit(Request(request_id=f"req-{i}", tokens=toks,
                              max_new_tokens=args.max_new_tokens,
-                             eos_id=args.eos_id))
+                             eos_id=args.eos_id,
+                             deadline_ms=args.deadline_ms))
     try:
-        stats = sched.run()
+        if journal is not None:
+            from apex_tpu.serve.resilience import ServeSupervisor
+
+            stats = ServeSupervisor(
+                sched, max_restarts=args.max_restarts).run()
+        else:
+            stats = sched.run()
     finally:
         if flight is not None:
             flight.detach()

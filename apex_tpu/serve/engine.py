@@ -218,6 +218,38 @@ class Engine:
         self._init_state(seed)
         return self
 
+    # --------------------------------------------- warm-restart support
+    def sampling_state(self) -> Dict[str, Any]:
+        """The host-side sampling state a tick journal snapshots: the
+        PRNG key (restoring it is what makes a ``temperature > 0``
+        stream replay bit-for-bit across a warm restart — the key path
+        is consumed one split per prefill/decode call), the per-slot
+        last tokens (the next decode inputs), and the host length
+        mirror (an integrity cross-check at restore)."""
+        return {"rng": np.asarray(self.rng).tolist(),
+                "last_tokens": self.last_tokens.tolist(),
+                "lengths": self._host_lengths.tolist()}
+
+    def restore_sampling_state(self, state: Dict[str, Any], *,
+                               slots: Sequence[int] = ()) -> None:
+        """Install a journaled sampling state after recovery re-prefill.
+
+        ``slots`` names the slot indices the caller re-prefilled; their
+        current cache lengths must equal the journaled ones (prompt +
+        generated-but-last) or the rebuilt cache does NOT hold the state
+        the PRNG/last-token restore assumes — refuse loudly rather than
+        continue a stream from the wrong prefix."""
+        want = np.asarray(state["lengths"], np.int64)
+        for slot in slots:
+            if self._host_lengths[slot] != want[slot]:
+                raise ValueError(
+                    f"recovery integrity: slot {slot} rebuilt to length "
+                    f"{int(self._host_lengths[slot])}, journal says "
+                    f"{int(want[slot])} — the re-prefilled prefix does "
+                    f"not match the journaled stream")
+        self.rng = jnp.asarray(np.asarray(state["rng"], np.uint32))
+        self.last_tokens = np.asarray(state["last_tokens"], np.int32)
+
     # ------------------------------------------------------------- calls
     def prefill(self, prompts: Dict[int, Sequence[int]]):
         """Insert ``{slot: prompt token ids}`` in one compiled call.

@@ -14,12 +14,29 @@ picks them up with zero wiring:
 
 - ``serve_request_admitted``  {request_id, slot, queue_wait_s}
 - ``serve_queue_wait``        {seconds} — a timed goodput cause: time a
-  request sat in the queue because no slot was free
+  request sat in the queue because no slot was free (published at
+  admission and at abort of a still-queued request, always the
+  INCREMENT not yet charged — a warm-restart re-admission can never
+  double-count a wait; a shed request's wait rides
+  ``serve_request_rejected`` and a deadline expiry charges its whole
+  span under ``serve_deadline_exceeded`` instead)
 - ``serve_request_completed`` {request_id, slot, new_tokens, ttft_s,
   latency_s, finish_reason}
 - ``serve_request_evicted``   {request_id, slot, reason} — mid-stream
   abort or shutdown; completed requests publish completed, not evicted
 - ``serve_decode_step``       {seconds, active} — per-step decode latency
+- ``serve_request_rejected``  {request_id, reason, retriable, seconds} —
+  admission control: the backlog was full (``max_queue``) and the shed
+  policy chose this request; ``seconds`` (time already queued, 0 for a
+  reject-at-submit) is a timed loss cause
+- ``serve_deadline_exceeded`` {request_id, slot, seconds, deadline_ms,
+  admitted} — the per-request deadline expired (queued-but-never-admitted
+  requests time out too); ``seconds`` — the whole submit-to-expiry span
+  was lost serving time — is a timed loss cause
+- ``serve_degraded_mode``     {entered, queue_depth, clamp} — sustained
+  overload flipped graceful degradation on/off
+- ``serve_engine_restart``    {restarts, resumed_slots, requeued, error}
+  — a warm restart recovered the fleet after a fatal tick exception
 
 Aborts can be driven deterministically by the resilience
 :class:`~apex_tpu.resilience.fault_injection.FaultInjector`
@@ -58,6 +75,10 @@ import numpy as np
 from apex_tpu.serve.engine import Engine
 from apex_tpu.utils.logging import publish_event
 
+# a request in one of these states has reached its exactly-one terminal
+# status; recovery and the drain path must never touch it again
+TERMINAL_STATES = ("completed", "evicted", "rejected")
+
 
 # eq=False: the queue holds request objects, not values — a resubmitted
 # identical prompt must not alias an existing request in `in`/`remove`
@@ -69,12 +90,26 @@ class Request:
     tokens: Sequence[int]                  # prompt token ids
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
+    # total latency budget from submit (monotonic sweep in step()); a
+    # queued-but-never-admitted request times out against it too
+    deadline_ms: Optional[float] = None
+    priority: int = 0         # higher wins under the "priority" shed policy
 
     # filled in by the scheduler
     generated: List[int] = dataclasses.field(default_factory=list)
-    state: str = "queued"     # queued|running|completed|evicted
-    finish_reason: Optional[str] = None   # eos|length|context|aborted
+    state: str = "queued"     # queued|running|completed|evicted|rejected
+    # eos|length|context|aborted|deadline|queue_full|shed|engine_failure
+    finish_reason: Optional[str] = None
     slot: Optional[int] = None
+    # effective token budget granted at admission (max_new_tokens, or the
+    # degraded-mode clamp of it). A separate field — never a mutation of
+    # max_new_tokens — so a warm-restart rollback re-admits against the
+    # CURRENT overload state, not a stale clamp from the torn tick
+    budget: Optional[int] = None
+    # queue-wait seconds already charged to the ledger: a request
+    # re-admitted after a warm-restart rollback charges only the
+    # increment, so the cause totals its true final wait
+    wait_charged: float = 0.0
     submit_t: Optional[float] = None
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
@@ -100,6 +135,11 @@ class Request:
             "new_tokens": len(self.generated),
             "generated": list(self.generated),
         }
+        if self.state == "rejected":
+            # load shedding is a server condition, not a request defect —
+            # the CLI surfaces the retriable status so clients back off
+            # and resubmit instead of treating it as a hard failure
+            out["retriable"] = True
         for k in ("ttft_s", "latency_s"):
             v = getattr(self, k)
             if v is not None:
@@ -120,6 +160,7 @@ class ServeStats:
     decode_tokens: int          # tokens produced BY decode steps
     total_new_tokens: int       # includes each request's prefill-sampled
     wall_s: float               # first token
+    restarts: int = 0           # warm restarts survived (recover() calls)
 
     def summary(self) -> Dict[str, Any]:
         lat = sorted(self.decode_step_s)
@@ -133,12 +174,23 @@ class ServeStats:
         ttfts = sorted(r["ttft_s"] for r in self.requests
                        if "ttft_s" in r)
         decode_s = sum(lat)
+        rejected = sum(r["state"] == "rejected" for r in self.requests)
         return {
             "requests": len(self.requests),
             "completed": sum(r["state"] == "completed"
                              for r in self.requests),
             "evicted": sum(r["state"] == "evicted"
                            for r in self.requests),
+            # SLO accounting: load shed + deadline misses + restarts are
+            # first-class summary fields (the bench entry and the CLI
+            # summary both carry them; shed_rate gates lower-is-better)
+            "rejected": rejected,
+            "deadline_exceeded": sum(
+                r.get("finish_reason") == "deadline"
+                for r in self.requests),
+            "shed_rate": round(rejected / len(self.requests), 4)
+            if self.requests else 0.0,
+            "restarts": self.restarts,
             "decode_steps": self.decode_steps,
             "new_tokens": self.total_new_tokens,
             # decode throughput: decode-produced tokens over decode-step
@@ -158,15 +210,31 @@ class ServeStats:
 class ServeScheduler:
     """Drive an :class:`Engine` over a request stream with continuous
     batching. ``fault_injector`` (optional) supplies scripted mid-stream
-    aborts; a real deployment calls :meth:`abort` directly —
-    :meth:`submit` and :meth:`abort` are safe from other threads while
-    :meth:`run` drives the loop (one reentrant lock serializes every
-    queue/slot mutation; a cross-thread call lands between ticks)."""
+    aborts, decode-step crashes, latency spikes, and queue storms; a real
+    deployment calls :meth:`abort` directly — :meth:`submit` and
+    :meth:`abort` are safe from other threads while :meth:`run` drives
+    the loop (one reentrant lock serializes every queue/slot mutation; a
+    cross-thread call lands between ticks).
+
+    Resilience seams (all optional, see
+    :mod:`apex_tpu.serve.resilience`): ``admission=`` an
+    :class:`~apex_tpu.serve.resilience.AdmissionController` bounds the
+    backlog with an explicit shed policy and drives graceful
+    degradation; ``journal=`` a
+    :class:`~apex_tpu.serve.resilience.TickJournal` snapshots request
+    metadata per tick so :meth:`recover` can warm-restart after a fatal
+    tick exception without losing a single request's terminal status.
+    Per-request ``deadline_ms`` is swept every tick (monotonic clocks)
+    whether or not the request was ever admitted."""
 
     def __init__(self, engine: Engine, *, fault_injector=None,
-                 tracer=None, flight_recorder=None, memory_accountant=None):
+                 tracer=None, flight_recorder=None, memory_accountant=None,
+                 admission=None, journal=None):
         self.engine = engine
         self.injector = fault_injector
+        self.admission = admission
+        self.journal = journal
+        self.restarts = 0
         # observability seams (all optional; None = zero work per tick)
         self.tracer = tracer if tracer is not None and tracer.enabled \
             else None
@@ -191,7 +259,12 @@ class ServeScheduler:
         self._t0: Optional[float] = None
 
     # --------------------------------------------------------- admission
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> bool:
+        """Enqueue ``req``. Returns ``True`` when it entered the backlog,
+        ``False`` when admission control rejected it (terminal state
+        ``rejected``, retriable — the record and bus event carry it);
+        malformed requests (empty/oversized prompt) still raise, they are
+        caller errors, not load."""
         if not len(req.tokens):
             raise ValueError(f"request {req.request_id!r}: empty prompt")
         if len(req.tokens) >= self.engine.max_len:
@@ -202,6 +275,22 @@ class ServeScheduler:
         req.submit_t = time.perf_counter()
         req.state = "queued"
         with self._lock:
+            if self.admission is not None:
+                verdict, victim = self.admission.on_submit(self.queue, req)
+                if verdict == "reject":
+                    reason = ("priority" if self.admission.shed_policy
+                              == "priority" else "queue_full")
+                    self._reject(req, reason, seconds=0.0)
+                    return False
+                if victim is not None:
+                    # shed a queued request to make room: its (not yet
+                    # charged) wait so far is lost time and the
+                    # rejection says so
+                    self.queue.remove(victim)
+                    self._reject(victim, "shed",
+                                 seconds=max(req.submit_t
+                                             - victim.submit_t
+                                             - victim.wait_charged, 0.0))
             if self.tracer is not None:
                 # one trace per request, rooted at submit; span stamps
                 # reuse the scheduler's own clock reads so trace durations
@@ -215,6 +304,22 @@ class ServeScheduler:
                     "queue": self.tracer.begin("queue", parent=root,
                                                t0=req.submit_t)}
             self.queue.append(req)
+        return True
+
+    def _reject(self, req: Request, reason: str, *, seconds: float) -> None:
+        """Terminal rejection (admission control / drain): accounted
+        exactly once, retriable, with the wasted queue time as a timed
+        loss cause."""
+        # caller holds self._lock (submit()/drain_and_reject())
+        req.state = "rejected"
+        req.finish_reason = reason
+        req.done_t = time.perf_counter()
+        self.done.append(req)
+        self._close_trace(req, "reject", reason)
+        publish_event("serve_request_rejected", level="warning",
+                      request_id=req.request_id, reason=reason,
+                      retriable=True, seconds=round(seconds, 6),
+                      queue_depth=len(self.queue))
 
     def _admit(self) -> None:
         """Fill free slots from the queue with ONE batched prefill call
@@ -235,7 +340,14 @@ class ServeScheduler:
         for slot, req in batch.items():
             req.admit_t = now
             req.state = "running"
-            wait = max(now - req.submit_t, 0.0)
+            # graceful degradation: under sustained overload the admitted
+            # token budget is clamped — shed work, not requests, until
+            # the queue drains
+            req.budget = (self.admission.clamp(req.max_new_tokens)
+                          if self.admission is not None
+                          else req.max_new_tokens)
+            wait = max(now - req.submit_t - req.wait_charged, 0.0)
+            req.wait_charged += wait
             publish_event("serve_queue_wait", seconds=wait,
                           request_id=req.request_id)
             publish_event("serve_request_admitted",
@@ -265,9 +377,11 @@ class ServeScheduler:
     def _accept_token(self, req: Request, tok: int) -> None:
         # caller holds self._lock (step()/_admit())
         req.generated.append(tok)
+        budget = req.budget if req.budget is not None \
+            else req.max_new_tokens
         if req.eos_id is not None and tok == req.eos_id:
             self._finish(req, "eos")
-        elif len(req.generated) >= req.max_new_tokens:
+        elif len(req.generated) >= budget:
             self._finish(req, "length")
         elif len(req.tokens) + len(req.generated) >= self.engine.max_len:
             self._finish(req, "context")
@@ -329,11 +443,24 @@ class ServeScheduler:
         """Mid-stream abort: evict a running request (or drop it from the
         queue). Other slots are untouched — bit-identical, by the static
         shapes of the engine. Safe to call from another thread while
-        :meth:`run` is mid-tick."""
+        :meth:`run` is mid-tick.
+
+        A still-queued (never-admitted) request is removed from the
+        queue, accounted exactly once, and publishes the same abort
+        event as an in-slot one — plus a ``serve_queue_wait`` record for
+        the time it sat waiting, which was lost either way and must land
+        under a goodput cause (admission publishes it for admitted
+        requests; before this, an aborted queued request's wait simply
+        vanished from the ledger)."""
         with self._lock:
             for req in list(self.queue):
                 if req.request_id == request_id:
                     self.queue.remove(req)
+                    publish_event(
+                        "serve_queue_wait",
+                        seconds=max(time.perf_counter() - req.submit_t
+                                    - req.wait_charged, 0.0),
+                        request_id=req.request_id)
                     self._evict(req, "aborted")
                     return True
             for req in self.slots:
@@ -341,6 +468,41 @@ class ServeScheduler:
                     self._evict(req, "aborted")
                     return True
             return False
+
+    def _sweep_deadlines(self, now: float) -> None:
+        """Expire every request whose ``deadline_ms`` has elapsed —
+        queued-but-never-admitted requests time out too (a client that
+        stopped waiting must not be prefilled). Monotonic clock deltas
+        only (apexlint APX005): ``submit_t`` is a ``perf_counter``
+        stamp."""
+        # caller holds self._lock (step())
+        for req in list(self.queue):
+            if req.deadline_ms is not None and \
+                    (now - req.submit_t) * 1e3 > req.deadline_ms:
+                self.queue.remove(req)
+                self._expire(req, now)
+        for req in list(self.slots):
+            if req is not None and req.deadline_ms is not None and \
+                    (now - req.submit_t) * 1e3 > req.deadline_ms:
+                self._expire(req, now)
+
+    def _expire(self, req: Request, now: float) -> None:
+        # caller holds self._lock (_sweep_deadlines())
+        waited = max(now - req.submit_t, 0.0)
+        req.state = "evicted"
+        req.finish_reason = "deadline"
+        req.done_t = now
+        self.done.append(req)
+        self._release(req)
+        self._close_trace(req, "deadline", "deadline")
+        # the whole submit-to-expiry span is lost serving time: the
+        # client gave up, whatever was computed is discarded
+        publish_event("serve_deadline_exceeded", level="warning",
+                      request_id=req.request_id, slot=req.slot,
+                      seconds=round(waited, 6),
+                      deadline_ms=req.deadline_ms,
+                      new_tokens=len(req.generated),
+                      admitted=req.admit_t is not None)
 
     def _evict(self, req: Request, reason: str) -> None:
         # caller holds self._lock (abort()/run())
@@ -357,22 +519,50 @@ class ServeScheduler:
 
     # ------------------------------------------------------------- steps
     def step(self) -> bool:
-        """One scheduler tick: scripted aborts -> backfill -> one decode
-        step -> per-slot termination. Returns False when idle (no running
-        or queued work). Holds the scheduler lock for the whole tick — a
-        cross-thread submit/abort lands between ticks, never mid-tick."""
+        """One scheduler tick: scripted faults -> deadline sweep ->
+        backfill -> one decode step -> per-slot termination -> journal.
+        Returns False when idle (no running or queued work). Holds the
+        scheduler lock for the whole tick — a cross-thread submit/abort
+        lands between ticks, never mid-tick."""
         with self._lock:
             if self._t0 is None:
                 self._t0 = time.perf_counter()
+            if self.journal is not None and self.journal.snapshot is None:
+                # pre-traffic baseline: a crash on the very first decode
+                # step still has a consistent state to recover to
+                self._journal_tick()
             if self.injector is not None:
                 for rid in self.injector.serve_aborts_due(
                         self.decode_steps):
                     self.abort(rid)
+                for spec in self.injector.serve_storm_due(
+                        self.decode_steps):
+                    # a scripted client burst: storms go through the
+                    # normal submit path so admission control is what is
+                    # actually under test
+                    self.submit(Request(**spec))
+            self._sweep_deadlines(time.perf_counter())
+            if self.admission is not None:
+                if self.memory is not None:
+                    self.admission.note_hbm(self.memory.last)
+                flip = self.admission.on_tick(len(self.queue))
+                if flip is not None:
+                    publish_event(
+                        "serve_degraded_mode", level="warning",
+                        entered=flip, queue_depth=len(self.queue),
+                        clamp=self.admission.degraded_max_new_tokens)
             self._admit()
             active = np.array([r is not None for r in self.slots], bool)
             if not active.any():
+                if self.journal is not None:
+                    self._journal_tick()
                 return bool(self.queue)
             t0 = time.perf_counter()
+            if self.injector is not None:
+                spike = self.injector.latency_spike_due(self.decode_steps)
+                if spike:
+                    time.sleep(spike)  # a stalled device/host hiccup
+                self.injector.maybe_crash_decode(self.decode_steps)
             next_tokens, _logits = self.engine.decode_step(
                 self.engine.last_tokens, active)
             dt = time.perf_counter() - t0
@@ -397,8 +587,175 @@ class ServeScheduler:
                 if req is not None:
                     self._accept_token(req, int(next_tokens[slot]))
             self._flush_evictions()
+            if self.journal is not None:
+                # end-of-tick: the state is consistent again — this is
+                # the snapshot a crash in the NEXT tick rolls back to
+                self._journal_tick()
             return any(r is not None
                        for r in self.slots) or bool(self.queue)
+
+    # --------------------------------------------- journal / warm restart
+    def _journal_tick(self) -> None:
+        """Record the current consistent state into the journal: request
+        metadata copies (a half-applied crashing tick can never poison
+        them) plus the engine's sampling state and PRNG key."""
+        # caller holds self._lock (step())
+        self.journal.record({
+            "decode_steps": self.decode_steps,
+            "decode_tokens": self.decode_tokens,
+            "engine": self.engine.sampling_state(),
+            "slots": [None if r is None else {
+                "req": r, "request_id": r.request_id,
+                # the prompt is immutable for the request's lifetime —
+                # a reference is crash-safe; only `generated` changes
+                # between ticks and needs the per-tick copy
+                "prompt": r.tokens,
+                "generated": list(r.generated),
+            } for r in self.slots],
+            "queued": list(self.queue),
+        })
+
+    def recover(self, error: Optional[str] = None) -> int:
+        """Warm restart after a fatal tick exception: roll back to the
+        journal's last consistent snapshot without losing any request.
+
+        Device state is rebuilt by re-prefilling each surviving slot's
+        accepted prefix (prompt + all but the last generated token)
+        through the existing bucketed prefill — bit-exact by the PR-5
+        prefill/decode invariant — then restoring the journaled sampling
+        state (PRNG key, last tokens), so surviving streams continue
+        exactly where the snapshot left them. Compiled executables are
+        reused: ``Engine.decode_traces`` does not grow (tier-1 asserts).
+        Requests that reached a terminal status during the crashing tick
+        keep it (their events already published — exactly-once); every
+        other in-flight request resumes, and queued ones (including
+        arrivals after the snapshot) are requeued in order. Returns the
+        number of slots re-prefilled."""
+        with self._lock:
+            if self.journal is None or self.journal.snapshot is None:
+                raise RuntimeError(
+                    "recover() needs ServeScheduler(journal=TickJournal"
+                    "(...)) — there is no snapshot to roll back to")
+            snap = self.journal.snapshot
+            self.restarts += 1
+            self.engine.reset()   # state drop; compiled artifacts kept
+            snap_ids = {id(ent["req"]) for ent in snap["slots"]
+                        if ent is not None}
+            # requeue: journaled order first, then post-snapshot arrivals
+            # that got ADMITTED during the crashing tick (popped from the
+            # live queue into a slot the snapshot never saw — they must
+            # roll back to queued, not vanish), then the rest of the live
+            # queue — nothing is dropped, and relative submit order holds
+            requeue: List[Request] = []
+            seen = set()
+            for req in snap["queued"]:
+                seen.add(id(req))
+                if req.state in TERMINAL_STATES:
+                    continue
+                self._rollback_to_queued(req)
+                requeue.append(req)
+            for req in list(self.slots):
+                if req is None or id(req) in snap_ids \
+                        or id(req) in seen \
+                        or req.state in TERMINAL_STATES:
+                    continue
+                seen.add(id(req))
+                self._rollback_to_queued(req)
+                requeue.append(req)
+            for req in list(self.queue):
+                if id(req) in seen or req.state in TERMINAL_STATES:
+                    continue
+                self._rollback_to_queued(req)
+                requeue.append(req)
+            self.queue = collections.deque(requeue)
+            self.slots = [None] * self.engine.config.num_slots
+            self._to_evict.clear()
+            prefixes: Dict[int, List[int]] = {}
+            for slot, ent in enumerate(snap["slots"]):
+                if ent is None:
+                    continue
+                req = ent["req"]
+                if req.state in TERMINAL_STATES:
+                    continue  # finished mid-crash-tick: status stands
+                req.state = "running"
+                req.slot = slot
+                req.generated = list(ent["generated"])
+                self.slots[slot] = req
+                # the cache must hold prompt + generated[:-1]: the last
+                # generated token is the NEXT decode input, not resident
+                prefixes[slot] = list(ent["prompt"]) + req.generated[:-1]
+            if prefixes:
+                # ONE prefill call, exactly like _admit: the engine pads
+                # every prefix to the shared pow2 bucket itself, so a
+                # mixed-length recovery pays at most one fresh bucket
+                # trace, never one per length class
+                self.engine.prefill(prefixes)
+            self.engine.restore_sampling_state(snap["engine"],
+                                               slots=sorted(prefixes))
+            self.decode_steps = snap["decode_steps"]
+            del self.decode_step_s[self.decode_steps:]
+            self.decode_tokens = snap["decode_tokens"]
+            publish_event("serve_engine_restart", level="warning",
+                          restarts=self.restarts,
+                          resumed_slots=len(prefixes),
+                          requeued=len(self.queue),
+                          error=error or "")
+            return len(prefixes)
+
+    def _rollback_to_queued(self, req: Request) -> None:
+        """Return a (possibly mid-crash-tick admitted) request to the
+        queue: progress from the torn tick is discarded — under greedy
+        decoding the replay regenerates it bit-for-bit."""
+        # caller holds self._lock (recover())
+        req.state = "queued"
+        req.slot = None
+        req.generated.clear()
+        req.admit_t = None
+        req.first_token_t = None
+        # the torn tick's admitted budget is void: re-admission grants a
+        # fresh one against the CURRENT degradation state, so a clamp
+        # from a past overload never outlives the overload
+        req.budget = None
+        sp = self._req_spans.get(req)
+        if sp is not None:
+            prefill = sp.pop("prefill", None)
+            if prefill is not None:
+                # it was admitted during the crashing tick: close the
+                # torn lifecycle spans and reopen the queue wait
+                self.tracer.end(prefill, status="cancelled", restart=True)
+                decode = sp.pop("decode", None)
+                if decode is not None:
+                    self.tracer.end(decode, status="cancelled",
+                                    restart=True)
+                sp["queue"] = self.tracer.begin("queue", parent=sp["root"],
+                                                restart=True)
+
+    def drain_and_reject(self, reason: str = "engine_failure") -> int:
+        """Terminal-status every still-live request WITHOUT touching the
+        (presumed dead) engine: queued requests are rejected (retriable
+        — a healthy replica can serve them), in-flight ones evicted.
+        The supervisor calls this when the restart budget is exhausted;
+        after it, every submitted request has exactly one terminal
+        status. Returns the number drained."""
+        n = 0
+        with self._lock:
+            now = time.perf_counter()
+            while self.queue:
+                req = self.queue.popleft()
+                self._reject(req, reason,
+                             seconds=max(now - req.submit_t
+                                         - req.wait_charged, 0.0))
+                n += 1
+            for slot, req in enumerate(self.slots):
+                if req is None:
+                    continue
+                # clear the slot FIRST so _release never schedules a
+                # device-side eviction on the dead engine
+                self.slots[slot] = None
+                self._evict(req, reason)
+                n += 1
+            self._to_evict.clear()
+        return n
 
     def run(self, max_steps: Optional[int] = None) -> ServeStats:
         """Run until idle (or ``max_steps`` decode steps); returns stats.
@@ -435,4 +792,5 @@ class ServeScheduler:
                           decode_tokens=self.decode_tokens,
                           total_new_tokens=sum(r["new_tokens"]
                                                for r in records),
-                          wall_s=wall)
+                          wall_s=wall,
+                          restarts=self.restarts)

@@ -409,6 +409,66 @@ def test_apx005_quiet_on_monotonic_gated_and_cli_prints(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx004_covers_serve_resilience_journal_writes(tmp_path):
+    """PR-8 coverage proof: a tick-journal ``save`` in
+    ``serve/resilience.py`` that skips the .tmp + os.replace discipline
+    fires APX004 (the rule's save/dump function-name scope reaches the
+    serve package), and the real atomic spelling stays quiet."""
+    _fixture(tmp_path, "apex_tpu/serve/resilience.py", """\
+        import json
+
+        class TickJournal:
+            def save(self, path):
+                with open(path, "w") as f:
+                    json.dump({"schema": 1}, f)
+        """)
+    active, _ = _run(tmp_path, "APX004")
+    assert len(active) == 1 and "non-atomic" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "serve" / "resilience.py"
+    good.write_text(textwrap.dedent("""\
+        import json, os
+
+        class TickJournal:
+            def save(self, path):
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"schema": 1}, f)
+                os.replace(tmp, path)
+        """))
+    active, _ = _run(tmp_path, "APX004")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx005_covers_deadline_sweep_clocks(tmp_path):
+    """PR-8 coverage proof: a deadline sweep in ``serve/resilience.py``
+    computed from ``time.time()`` deltas fires APX005 (an NTP step would
+    expire every in-flight request at once); the monotonic spelling the
+    real sweep uses stays quiet."""
+    _fixture(tmp_path, "apex_tpu/serve/resilience.py", """\
+        import time
+
+        def sweep_deadlines(queue):
+            now = time.time()
+            return [r for r in queue
+                    if (now - r.submit_t) * 1e3 > r.deadline_ms]
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert len(active) == 1 and "monotonic" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "serve" / "resilience.py"
+    good.write_text(textwrap.dedent("""\
+        import time
+
+        def sweep_deadlines(queue):
+            now = time.perf_counter()
+            return [r for r in queue
+                    if (now - r.submit_t) * 1e3 > r.deadline_ms]
+        """))
+    active, _ = _run(tmp_path, "APX005")
+    assert not active, [v.format() for v in active]
+
+
 # --------------------------------------------------- 3. suppressions
 
 def test_justified_suppression_suppresses_and_is_counted(tmp_path):

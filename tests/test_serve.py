@@ -38,6 +38,11 @@ from apex_tpu.resilience.fault_injection import FaultInjector
 from apex_tpu.serve.engine import Engine, EngineConfig, init_gpt2_params
 from apex_tpu.serve.kv_cache import init_cache, write_token
 from apex_tpu.serve.scheduler import Request, ServeScheduler
+# bound at collection time: test_chip_worker purges apex_tpu.* from
+# sys.modules mid-session, and a function-local re-import after that
+# would subscribe to a FRESH bus while the (old) scheduler module keeps
+# publishing to the original one
+from apex_tpu.utils.logging import subscribe_events
 
 pytestmark = pytest.mark.serve
 
@@ -253,6 +258,56 @@ def test_mid_stream_abort_leaves_other_slots_bit_identical(greedy2):
     assert led.summary()["events"]["serve_request_evicted"] == 1
 
 
+@pytest.mark.fault
+def test_abort_of_still_queued_request(greedy2):
+    """Satellite regression (PR 8): aborting a request that was never
+    admitted must remove it from the queue, account it exactly once,
+    publish the abort event — and charge its wasted queue time as a
+    ``serve_queue_wait`` loss (previously the wait silently vanished).
+    Both entry points: a direct cross-thread-style abort() call and the
+    FaultInjector-scripted path."""
+    # direct call, before any tick: 3 requests, 2 slots -> "c" queued
+    sched = ServeScheduler(greedy2.reset())
+    for rid in ("a", "b", "c"):
+        sched.submit(Request(request_id=rid, tokens=_tokens(5),
+                             max_new_tokens=3))
+    assert sched.abort("c") is True
+    assert all(r.request_id != "c" for r in sched.queue)
+    assert sched.abort("c") is False      # terminal: never re-accounted
+    stats = sched.run()
+    recs = {r["request_id"]: r for r in stats.requests}
+    assert len(stats.requests) == 3
+    assert recs["c"]["state"] == "evicted"
+    assert recs["c"]["finish_reason"] == "aborted"
+    assert recs["c"]["new_tokens"] == 0
+    assert recs["a"]["state"] == recs["b"]["state"] == "completed"
+
+    # injector path mid-run, with the event + queue-wait accounting
+    seen = []
+    unsub = subscribe_events(
+        lambda r: seen.append(r)
+        if r.get("request_id") == "r2"
+        and r.get("event") in ("serve_request_evicted",
+                               "serve_queue_wait") else None)
+    try:
+        inj = FaultInjector(seed=0).abort_request("r2", at_step=1)
+        sched = ServeScheduler(greedy2.reset(), fault_injector=inj)
+        for i in range(3):
+            sched.submit(Request(request_id=f"r{i}",
+                                 tokens=_tokens(5, seed=i),
+                                 max_new_tokens=4))
+        stats = sched.run()
+    finally:
+        unsub()
+    recs = {r["request_id"]: r for r in stats.requests}
+    assert recs["r2"]["state"] == "evicted"
+    assert recs["r2"]["finish_reason"] == "aborted"
+    evicted = [r for r in seen if r["event"] == "serve_request_evicted"]
+    waits = [r for r in seen if r["event"] == "serve_queue_wait"]
+    assert len(evicted) == 1 and evicted[0]["reason"] == "aborted"
+    assert len(waits) == 1 and waits[0]["seconds"] >= 0.0
+
+
 # -------------------------------------------------------- determinism
 
 def test_greedy_is_deterministic_and_argmax(greedy3, keeper3):
@@ -358,8 +413,6 @@ def test_untraced_scheduler_publishes_no_spans(greedy3):
     """Tracing disabled (the default) adds nothing: no span records on
     the bus, no per-request bookkeeping, and — asserted everywhere else
     in this file — no extra compiles."""
-    from apex_tpu.utils.logging import subscribe_events
-
     seen = []
     unsub = subscribe_events(
         lambda r: seen.append(r) if str(r.get("event", "")).startswith(
@@ -573,6 +626,17 @@ def test_bench_serve_smoke_and_regression_gate(tmp_path, capsys):
     assert check_regression.main([str(path_cur), "--suite",
                                   str(path_base),
                                   "--kernels", "serve_decode"]) == 0
+    # SLO counters gate from a ZERO baseline: the healthy default
+    # workload ships rejected=0/shed_rate=0, and a capture that starts
+    # shedding must regress — a base==0 ratio skip would let it ship
+    assert entry["rejected"] == 0 and entry["shed_rate"] == 0.0
+    shedding = json.loads(json.dumps(suite))
+    shedding["serve_decode"]["rejected"] = 5
+    shedding["serve_decode"]["shed_rate"] = 0.31
+    path_cur.write_text(json.dumps(shedding))
+    assert check_regression.main([str(path_cur), "--suite",
+                                  str(path_base),
+                                  "--kernels", "serve_decode"]) == 1
 
 
 # --------------------------------------------- gpt2 position offsets

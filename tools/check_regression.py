@@ -63,8 +63,12 @@ EXCLUDED = {"step", "t", "bench_wall_s", "fetch_floor_ms", "found_inf",
             "steps", "slots"}
 _LOWER_SUFFIXES = ("_ms", "_s", "_latency")
 # serving latency names beat the generic rules ("ttft" carries no unit
-# suffix when reported in seconds; p50/p99 quantile columns are latencies)
-_LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait")
+# suffix when reported in seconds; p50/p99 quantile columns are latencies).
+# Overload SLO counters are failure rates: more shed/rejected/expired
+# requests is strictly worse — without the hint "rejected" would default
+# to higher-is-better and a shedding regression would gate as a win.
+_LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
+                "shed_rate", "rejected", "deadline_exceeded")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate)
 _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
@@ -236,7 +240,22 @@ def compare(current: Dict[str, Tuple[float, Optional[str]]],
         base, base_unit = baseline[name]
         lower = lower_is_better(name, unit or base_unit)
         if base == 0:
-            skipped.append(name)
+            # no relative ratio exists — but for a lower-is-better
+            # failure counter (rejected, deadline_exceeded, shed_rate,
+            # a latency) a 0 -> N move is the regression the gate
+            # exists to catch: skipping it would let a healthy-baseline
+            # capture start shedding silently. 0 -> 0 is a clean pass;
+            # higher-is-better metrics with a zero baseline stay
+            # skipped (any value is an improvement of unknowable size).
+            if lower:
+                results.append({
+                    "metric": name, "baseline": base, "current": cur,
+                    "ratio": float("inf") if cur > 0 else 1.0,
+                    "direction": "lower",
+                    "regressed": cur > 0,
+                })
+            else:
+                skipped.append(name)
             continue
         ratio = cur / base
         worse = ratio - 1.0 if lower else 1.0 - ratio
