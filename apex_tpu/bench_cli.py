@@ -24,7 +24,12 @@ refreshing the committed gate is one command.
 (apex_tpu.serve continuous batching on the tiny fp32 GPT-2): decode
 tokens/s, p50/p99 per-token latency, and TTFT as a ``serve_decode``
 BENCH_SUITE entry — same ``--emit-baseline`` + check_regression suite
-workflow as the kernel gate (docs/serving.md).
+workflow as the kernel gate (docs/serving.md). ``--page-size``/
+``--num-pages``/``--prefix-cache`` swap in the paged KV pool, and
+``--prompt-len MIN:MAX`` + ``--shared-prefix N`` script the
+mixed-length multi-tenant workload the pool's
+``resident_tokens_per_hbm_byte`` / ``prefix_hit_rate`` capacity claims
+are measured on (docs/serving.md "Paged KV pool and prefix caching").
 """
 
 from __future__ import annotations
@@ -240,11 +245,29 @@ def _load_bench_module():
     return bench
 
 
+def _parse_prompt_lens(spec: str) -> "tuple[int, int]":
+    """``"8"`` -> (8, 8); ``"4:24"`` -> (4, 24) — the mixed-length range
+    scripted prompts are drawn from (uniform, seeded)."""
+    lo, _, hi = spec.partition(":")
+    lo = int(lo)
+    hi = int(hi) if hi else lo
+    if lo < 1 or hi < lo:
+        raise ValueError(f"--prompt-len {spec!r}: need MIN[:MAX] with "
+                         f"1 <= MIN <= MAX")
+    return lo, hi
+
+
 def _serve_bench(steps: int, num_slots: int = 4,
                  emit_baseline: "str | None" = None,
                  deadline_ms: "float | None" = None,
                  max_queue: "int | None" = None,
-                 shed_policy: str = "reject-newest") -> None:
+                 shed_policy: str = "reject-newest",
+                 max_len: int = 64,
+                 prompt_len: str = "8",
+                 shared_prefix: int = 0,
+                 page_size: "int | None" = None,
+                 num_pages: "int | None" = None,
+                 prefix_cache: bool = False) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -253,6 +276,17 @@ def _serve_bench(steps: int, num_slots: int = 4,
     as are the overload SLO fields (``rejected``, ``deadline_exceeded``,
     ``shed_rate``) the entry carries when ``--deadline-ms``/``--max-queue``
     shape the workload.
+
+    The paged-pool knobs (``--page-size``/``--num-pages``/
+    ``--prefix-cache``) plus the workload shapers (``--prompt-len
+    MIN:MAX`` mixed lengths, ``--shared-prefix N`` a fleet-wide system
+    prompt every request starts with) are what the capacity claim is
+    measured on: ``resident_tokens_per_hbm_byte`` (peak resident tokens
+    over the engine's KV reservation — the number paging multiplies at
+    equal HBM budget) and ``prefix_hit_rate`` (admissions served partly
+    from shared prefix pages) land in the entry, higher-is-better, and
+    every pool/workload knob rides the nested ``workload`` provenance so
+    the gate never compares incomparable configs (PR-8 precedent).
     """
     import dataclasses
     import json
@@ -271,13 +305,43 @@ def _serve_bench(steps: int, num_slots: int = 4,
 
     from apex_tpu.utils.env import capture_provenance
 
-    cfg = dataclasses.replace(GPT2Config.tiny(),
-                              compute_dtype=jnp.float32)
-    engine = Engine(cfg, init_gpt2_params(cfg),
-                    EngineConfig(num_slots=num_slots, max_len=64,
-                                 temperature=0.0), seed=0)
-    prompt_len = 8
-    engine.aot_compile([prompt_len])  # compiles land before the clock
+    try:
+        plo, phi = _parse_prompt_lens(prompt_len)
+    except ValueError as e:
+        raise SystemExit(f"apex-tpu-bench: {e}")
+    cfg = GPT2Config.tiny()
+    if max_len > cfg.n_positions:
+        # the tiny preset caps context at its n_positions; a deeper bench
+        # workload (e.g. the 32-1024 mixed sweep) needs longer rope/wpe
+        cfg = dataclasses.replace(cfg, n_positions=max_len)
+    cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32)
+    try:
+        engine = Engine(cfg, init_gpt2_params(cfg),
+                        EngineConfig(num_slots=num_slots, max_len=max_len,
+                                     temperature=0.0, page_size=page_size,
+                                     num_pages=num_pages,
+                                     prefix_cache=prefix_cache), seed=0)
+    except ValueError as e:
+        # bad pool geometry (page_size not dividing max_len, undersized
+        # num_pages, ...) is a usage error, same as the prefix check below
+        raise SystemExit(f"apex-tpu-bench: {e}")
+    if shared_prefix + phi >= max_len:
+        raise SystemExit(
+            f"apex-tpu-bench: --shared-prefix {shared_prefix} + "
+            f"--prompt-len max {phi} leaves no room to generate under "
+            f"--serve-max-len {max_len}")
+    # warm EVERY reachable prefill bucket, not just the longest prompt's:
+    # mixed-length batches and prefix-hit tails (the scan covers only the
+    # unshared remainder) land on smaller pow2 buckets, and a fresh
+    # compile inside the timed region would corrupt the TTFT/p99 the
+    # gate compares — log-many buckets, all paid before the clock
+    top = shared_prefix + phi
+    buckets, b = [], 1
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    buckets.append(top)
+    engine.aot_compile(buckets)
     rng = np.random.RandomState(0)
     admission = None
     if max_queue is not None:
@@ -288,11 +352,13 @@ def _serve_bench(steps: int, num_slots: int = 4,
     sched = ServeScheduler(engine, admission=admission)
     # enough requests to keep every slot busy and exercise backfill
     n_requests = max(2 * num_slots, (steps * num_slots) // 8 + 1)
+    system = [int(t) for t in rng.randint(0, cfg.vocab_size,
+                                          shared_prefix)]
     for i in range(n_requests):
+        plen = int(rng.randint(plo, phi + 1))
+        tail = [int(t) for t in rng.randint(0, cfg.vocab_size, plen)]
         sched.submit(Request(
-            request_id=f"bench-{i}",
-            tokens=[int(t) for t in rng.randint(0, cfg.vocab_size,
-                                                prompt_len)],
+            request_id=f"bench-{i}", tokens=system + tail,
             max_new_tokens=8, deadline_ms=deadline_ms))
     t0 = time.perf_counter()
     stats = sched.run(max_steps=steps)
@@ -314,6 +380,17 @@ def _serve_bench(steps: int, num_slots: int = 4,
             "rejected": s["rejected"],
             "deadline_exceeded": s["deadline_exceeded"],
             "shed_rate": s["shed_rate"],
+            # paged-pool effectiveness (higher-is-better; the gate
+            # knows): peak resident tokens per byte of KV reservation —
+            # the capacity number paging multiplies at equal HBM budget —
+            # and the fraction of admissions served partly from shared
+            # prefix pages
+            # significant digits, not decimal places: a production-scale
+            # pool puts this gate metric near 1e-8, where round(x, 9)
+            # would quantize away a real 5-10% capacity regression
+            "resident_tokens_per_hbm_byte": float(
+                f"{s['peak_resident_tokens'] / max(engine.kv_cache_bytes, 1):.6g}"),
+            "prefix_hit_rate": s["prefix_hit_rate"],
             "bench_wall_s": round(wall, 3),
             # workload config nested as a dict: check_regression lifts
             # only numeric scalars, so a capture with different
@@ -327,7 +404,19 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          "slots": num_slots,
                          "deadline_ms": deadline_ms,
                          "max_queue": max_queue,
-                         "shed_policy": shed_policy},
+                         "shed_policy": shed_policy,
+                         # pool geometry provenance: a capture whose
+                         # capacity/hit-rate numbers were shaped by a
+                         # different page_size (or no paging at all) is
+                         # identifiable, never silently gated against
+                         "max_len": max_len,
+                         "page_size": page_size or 0,
+                         "num_pages": engine._num_pages
+                         if page_size else 0,
+                         "prefix_cache": bool(prefix_cache),
+                         "prompt_len": prompt_len,
+                         "shared_prefix": shared_prefix,
+                         "kv_cache_bytes": engine.kv_cache_bytes},
             # a subset capture, not the full committed suite
             "complete": False,
         },
@@ -434,6 +523,27 @@ def main() -> None:
             ap.add_argument("--shed-policy", default="reject-newest",
                             choices=["reject-newest", "shed-oldest",
                                      "priority"])
+            ap.add_argument("--serve-max-len", type=int, default=64,
+                            help="per-request context bound (prompt + "
+                                 "generated); deep mixed-length "
+                                 "workloads need it above the default")
+            ap.add_argument("--prompt-len", default="8",
+                            help="scripted prompt length: N, or MIN:MAX "
+                                 "for a seeded mixed-length workload")
+            ap.add_argument("--shared-prefix", type=int, default=0,
+                            help="every prompt starts with this many "
+                                 "shared tokens (the fleet-wide system "
+                                 "prompt --prefix-cache deduplicates)")
+            ap.add_argument("--page-size", type=int, default=None,
+                            help="tokens per KV page: paged block pool "
+                                 "instead of per-slot reservation")
+            ap.add_argument("--num-pages", type=int, default=None,
+                            help="pool pages incl. the null page "
+                                 "(default: slot-cache-equivalent "
+                                 "capacity; smaller overcommits)")
+            ap.add_argument("--prefix-cache", action="store_true",
+                            help="share read-only prompt-prefix pages "
+                                 "across requests (needs --page-size)")
             ap.add_argument("--emit-baseline", nargs="?",
                             const="BENCH_BASELINE_SERVE.json",
                             default=None,
@@ -444,7 +554,13 @@ def main() -> None:
                          args.emit_baseline,
                          deadline_ms=args.deadline_ms,
                          max_queue=args.max_queue,
-                         shed_policy=args.shed_policy)
+                         shed_policy=args.shed_policy,
+                         max_len=args.serve_max_len,
+                         prompt_len=args.prompt_len,
+                         shared_prefix=args.shared_prefix,
+                         page_size=args.page_size,
+                         num_pages=args.num_pages,
+                         prefix_cache=args.prefix_cache)
         elif has_telemetry:
             import argparse
 

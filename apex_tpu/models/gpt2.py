@@ -162,9 +162,24 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
     discarded and write nothing. Returns ``(logits [num_slots, vocab]
     fp32, cache)``. ``block_k`` is the decode-attention KV chunk
     (autotuned via ``apex_tpu.tune`` when None).
+
+    ``cache`` may be either layout: the slot-contiguous
+    :class:`~apex_tpu.serve.kv_cache.KVCache` or the paged
+    :class:`~apex_tpu.serve.kv_cache.PagedKVCache`. The dispatch is
+    static (an ``isinstance`` on the pytree class at trace time); the
+    attention chunk arithmetic is shared, so the two layouts are
+    bit-identical in fp32 on identical resident bytes at equal
+    ``block_k`` (the chunk size orders the softmax partial sums).
     """
-    from apex_tpu.serve.attention import cached_attention
-    from apex_tpu.serve.kv_cache import write_token
+    from apex_tpu.serve.attention import cached_attention, paged_attention
+    from apex_tpu.serve.kv_cache import paged_write_token, write_token
+
+    # layout dispatch is structural, NOT isinstance: these imports are
+    # function-local (the serve package imports this module), so a
+    # purge-and-reimport of apex_tpu.serve.kv_cache mid-process would
+    # make isinstance(cache, PagedKVCache) compare against a fresh class
+    # and silently route a paged cache down the slot path
+    paged = hasattr(cache, "page_table")
 
     c = cfg
     dt = c.compute_dtype
@@ -184,9 +199,14 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
         q = q.reshape(-1, h, d)
         k = k.reshape(-1, h, d)
         v = v.reshape(-1, h, d)
-        cache = write_token(cache, i, k, v, pos, write_mask)
-        o = cached_attention(q, cache.k[i], cache.v[i], pos,
-                             block_k=block_k)
+        if paged:
+            cache = paged_write_token(cache, i, k, v, pos, write_mask)
+            o = paged_attention(q, cache.k[i], cache.v[i],
+                                cache.page_table, pos, block_k=block_k)
+        else:
+            cache = write_token(cache, i, k, v, pos, write_mask)
+            o = cached_attention(q, cache.k[i], cache.v[i], pos,
+                                 block_k=block_k)
         o = o.reshape(-1, c.n_embd)
         x = x + (o.astype(dt) @ blk["attn_out"]["kernel"].astype(dt)
                  + blk["attn_out"]["bias"].astype(dt))

@@ -53,6 +53,11 @@ STALL_EVENTS = {
     # partition the wall clock the way training causes do.
     "serve_deadline_exceeded": "serve_deadline_exceeded",
     "serve_request_rejected": "serve_rejected",
+    # paged KV pool (PR 9): admission stalled at the head of the queue
+    # because no pool page was free — the whole stall window is lost
+    # capacity attributable to KV bytes, distinct from serve_queue_wait
+    # (slot scarcity); the two overlap in wall time by design
+    "serve_page_alloc_fail": "serve_page_alloc_fail",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
@@ -68,6 +73,9 @@ COUNTED_EVENTS = (
     "serve_request_admitted", "serve_request_completed",
     "serve_request_evicted", "serve_decode_step",
     "serve_engine_restart", "serve_degraded_mode",
+    # a prefix-cache hit at admission: hit_tokens were served from
+    # resident read-only pages instead of being re-prefilled
+    "serve_prefix_hit",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

@@ -1,7 +1,8 @@
-"""Decode attention over the static KV cache.
+"""Decode attention over the static KV cache — slot-contiguous or paged.
 
 One query token per slot against that slot's cached keys/values. The key
-axis is the cache's static ``max_len``; reachability is a mask
+axis is static (the slot cache's ``max_len``, or the paged cache's
+``max_pages_per_slot * page_size`` virtual axis); reachability is a mask
 (``key_pos <= position``), never a shape — so the op compiles once and a
 slot's result depends only on that slot's bytes (reductions run within a
 slot; other slots' values cannot perturb the arithmetic, which is what
@@ -17,13 +18,26 @@ is a real tile-geometry knob, with
 committed heuristic. Both the prefill scan body and the decode step call
 this function with the same geometry, so the two paths stay bit-identical.
 
+**The paged path shares the slot path's arithmetic verbatim**: the only
+difference is where a chunk's K/V rows are fetched from (a contiguous
+slice of the slot's buffer vs. a page-table gather — ``block_k`` divides
+``page_size``, so every chunk lives inside exactly one page). Scores,
+masking, the max combine, and the sum order are the same code, which is
+why a paged engine is bit-exact in fp32 against the slot engine on
+identical traces **at the same block_k** (tier-1 asserts, with the slot
+cache as the oracle). The *default* chunk differs per layout — the
+heuristic/tuner unit is ``max_len`` for the slot cache but ``page_size``
+for the pool — and a different ``block_k`` reorders the partial sums by
+design (±1 ulp), exactly as it does between two ``block_k`` values on
+the same layout; pin ``block_k`` to compare layouts bitwise.
+
 All math fp32 (max-subtracted softmax; the row's own token is always
 reachable, so the denominator is never empty); IO dtype preserved.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,10 +51,23 @@ NEG_INF = jnp.float32(-1e30)
 
 def resolve_block_k(max_len: int, heads: int, head_dim: int, dtype,
                     block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None) -> int:
+                    interpret: Optional[bool] = None,
+                    page_size: Optional[int] = None) -> int:
     """The decode KV-chunk size: explicit value (validated), else the
-    autotuned winner for this (max_len, heads, head_dim, dtype, chip),
-    else the committed heuristic."""
+    autotuned winner for this (max_len, page_size, heads, head_dim,
+    dtype, chip), else the committed heuristic.
+
+    With a paged cache (``page_size`` set) the chunk must additionally
+    divide ``page_size`` so every chunk's rows live inside one page —
+    the fetch is then a single page gather plus a static slice, and the
+    geometry the autotuner times is the true streamed working set.
+    """
+    if page_size is not None:
+        ps = int(page_size)
+        if ps <= 0 or max_len % ps:
+            raise ValueError(
+                f"page_size={ps} must be positive and divide the cache "
+                f"max_len={max_len}")
     if block_k is not None:
         bk = int(block_k)
         if bk <= 0 or max_len % bk:
@@ -48,19 +75,72 @@ def resolve_block_k(max_len: int, heads: int, head_dim: int, dtype,
                 f"block_k={bk} must be positive and divide the cache "
                 f"max_len={max_len} (the chunked softmax tiles the static "
                 f"key axis exactly)")
+        if page_size is not None and int(page_size) % bk:
+            raise ValueError(
+                f"block_k={bk} must divide page_size={page_size}: each "
+                f"chunked-softmax tile must live inside one KV page "
+                f"(pick a block_k that divides the page, or a page_size "
+                f"that is a multiple of the tuned block)")
         return bk
     # max_len is keyed EXACTLY (not pow2-bucketed): it is a static,
     # layout-defining engine constant and the winner must divide it — a
     # bucketed key would warm entries that can never validate for
-    # non-pow2 cache lengths
+    # non-pow2 cache lengths. page_size is a geometry axis of the same
+    # kind (0 = slot cache): a winner tuned for one page size cannot
+    # apply to another.
+    ps = int(page_size) if page_size is not None else 0
+    unit = ps if ps else int(max_len)
     p = tuned_params(
         "decode_attention",
-        (("max_len", int(max_len)), ("heads", heads), ("d", head_dim)),
-        {"block_k": decode_attention_block(max_len)},
+        (("max_len", int(max_len)), ("page_size", ps), ("heads", heads),
+         ("d", head_dim)),
+        {"block_k": decode_attention_block(unit)},
         dtype=dtype, interpret=interpret,
         validate=lambda pr: (pr["block_k"] > 0
-                             and max_len % pr["block_k"] == 0))
+                             and max_len % pr["block_k"] == 0
+                             and (not ps or ps % pr["block_k"] == 0)))
     return int(p["block_k"])
+
+
+def _combine_chunks(q: jax.Array, positions: jax.Array, L: int, bk: int,
+                    scale: jnp.float32,
+                    fetch: Callable[[int], Tuple[jax.Array, jax.Array]],
+                    ) -> jax.Array:
+    """The shared chunked-softmax core: ``fetch(i)`` returns chunk ``i``'s
+    ``(k_rows, v_rows)`` as ``[b, block_k, heads, head_dim]`` — a
+    contiguous slice for the slot cache, a page gather for the paged pool.
+    Everything numeric happens HERE, identically for both layouts: each
+    score's reduction runs over ``d`` (not ``L``), the global row max
+    equals the max over chunk maxima bit-for-bit, and only the SUM order
+    depends on ``block_k`` — identically in prefill and decode, and
+    identically in slot and paged engines.
+    """
+    b, h, d = q.shape
+    q32 = q.astype(_f32)
+    pos = positions.astype(jnp.int32)[:, None, None]
+    nchunk = L // bk
+
+    def chunk_scores(i):
+        ks, vs = fetch(i)                 # ONE fetch per chunk: a second
+        # call would trace the K and V gathers twice (and execute them
+        # twice under interpret=True) just to rely on XLA CSE
+        sc = jnp.einsum("bhd,bkhd->bhk", q32, ks.astype(_f32)) * scale
+        kpos = jnp.arange(i * bk, (i + 1) * bk, dtype=jnp.int32)
+        reach = kpos[None, None, :] <= pos
+        return jnp.where(reach, sc, NEG_INF), reach, vs
+
+    chunks = [chunk_scores(i) for i in range(nchunk)]      # static unroll
+    m = chunks[0][0].max(axis=-1, keepdims=True)
+    for sc, _, _ in chunks[1:]:
+        m = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
+
+    num = jnp.zeros((b, h, d), _f32)
+    den = jnp.zeros((b, h), _f32)
+    for sc, reach, vs in chunks:
+        e = jnp.where(reach, jnp.exp(sc - m), 0.0)         # [b, h, bk]
+        den = den + jnp.sum(e, axis=-1)
+        num = num + jnp.einsum("bhk,bkhd->bhd", e, vs.astype(_f32))
+    return (num / den[..., None]).astype(q.dtype)
 
 
 def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
@@ -68,7 +148,7 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      scale: Optional[float] = None,
                      block_k: Optional[int] = None,
                      interpret: Optional[bool] = None) -> jax.Array:
-    """Single-token attention over cached K/V.
+    """Single-token attention over slot-contiguous cached K/V.
 
     ``q``: ``[num_slots, heads, head_dim]`` (this step's query per slot);
     ``k_cache``/``v_cache``: ``[num_slots, max_len, heads, head_dim]``;
@@ -84,32 +164,44 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # fully chunked over the key axis: scores, masking, exp, and the
     # V-side accumulation all touch one [block_k] tile of K and V per
     # step, so block_k genuinely bounds the streamed working set (the
-    # premise the decode_attention autotuner times). Chunking changes no
-    # value: each score's reduction runs over d (not L), and the global
-    # row max equals the max over chunk maxima bit-for-bit — only the
-    # SUM order depends on block_k, identically in prefill and decode.
-    q32 = q.astype(_f32)
-    pos = positions.astype(jnp.int32)[:, None, None]
-    nchunk = L // bk
+    # premise the decode_attention autotuner times)
+    def fetch(i):
+        sl = slice(i * bk, (i + 1) * bk)
+        return k_cache[:, sl], v_cache[:, sl]
 
-    def chunk_scores(i):
-        ks = k_cache[:, i * bk:(i + 1) * bk].astype(_f32)
-        sc = jnp.einsum("bhd,bkhd->bhk", q32, ks) * s     # [b, h, bk]
-        kpos = jnp.arange(i * bk, (i + 1) * bk, dtype=jnp.int32)
-        reach = kpos[None, None, :] <= pos
-        return jnp.where(reach, sc, NEG_INF), reach
+    return _combine_chunks(q, positions, L, bk, s, fetch)
 
-    chunks = [chunk_scores(i) for i in range(nchunk)]     # static unroll
-    m = chunks[0][0].max(axis=-1, keepdims=True)
-    for sc, _ in chunks[1:]:
-        m = jnp.maximum(m, sc.max(axis=-1, keepdims=True))
 
-    num = jnp.zeros((b, h, d), _f32)
-    den = jnp.zeros((b, h), _f32)
-    for i, (sc, reach) in enumerate(chunks):
-        e = jnp.where(reach, jnp.exp(sc - m), 0.0)        # [b, h, bk]
-        den = den + jnp.sum(e, axis=-1)
-        num = num + jnp.einsum(
-            "bhk,bkhd->bhd", e, v_cache[:, i * bk:(i + 1) * bk]
-            .astype(_f32))
-    return (num / den[..., None]).astype(q.dtype)
+def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                    page_table: jax.Array, positions: jax.Array, *,
+                    scale: Optional[float] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Single-token attention through the page table.
+
+    ``q``: ``[num_slots, heads, head_dim]``; ``k_pool``/``v_pool``:
+    ``[num_pages, page_size, heads, head_dim]`` (one layer of the paged
+    pool); ``page_table``: ``[num_slots, max_pages_per_slot]`` int32;
+    ``positions``: ``[num_slots]`` int32 over each slot's VIRTUAL key
+    axis (page-table row laid flat). Chunk ``i`` of the virtual axis
+    lives inside page ``page_table[:, (i * block_k) // page_size]``
+    (``block_k`` divides ``page_size``), so the fetch is one page gather
+    plus a static in-page slice — the working set per partial reduction
+    is the same ``[block_k, head_dim]`` tile as the slot path, and the
+    combine is the SAME code, bit-for-bit. Unmapped table entries point
+    at the null page; its rows sit past every live position, so the
+    reachability mask discards them.
+    """
+    P, ps, h, d = k_pool.shape
+    L = int(page_table.shape[1]) * ps
+    bk = resolve_block_k(L, h, d, q.dtype, block_k, interpret,
+                         page_size=ps)
+    s = jnp.float32(scale if scale is not None else 1.0 / (d ** 0.5))
+
+    def fetch(i):
+        start = i * bk
+        pages = page_table[:, start // ps]                 # [b]
+        sl = slice(start % ps, start % ps + bk)            # static in-page
+        return k_pool[pages, sl], v_pool[pages, sl]
+
+    return _combine_chunks(q, positions, L, bk, s, fetch)

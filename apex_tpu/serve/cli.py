@@ -25,6 +25,15 @@ rejection, ``--max-restarts N`` arms the tick journal + warm-restart
 supervisor so a fatal tick exception recovers instead of killing every
 in-flight request.
 
+Paged KV pool (docs/serving.md "Paged KV pool and prefix caching"):
+``--page-size N`` swaps the per-slot ``max_len`` reservation for a
+shared block pool (``--num-pages`` sizes it; default = same token
+capacity as the slot cache, size it smaller to overcommit), and
+``--prefix-cache`` shares read-only prompt-prefix pages across requests
+so a repeated system prompt is prefilled once. The summary's
+``prefix_hit_rate`` / ``peak_resident_tokens`` report what the pool
+bought; decode still compiles exactly once (``decode_compiles``).
+
 Example::
 
     apex-tpu-serve --config tiny --requests 4 --max-new-tokens 8 \
@@ -80,6 +89,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="warm restarts to attempt after a fatal tick "
                          "exception (tick journal + recovery; 0 = fail "
                          "fast, the pre-PR-8 behavior)")
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="tokens per KV page: enables the paged block "
+                         "pool (must divide --max-len; the tuned decode "
+                         "block_k must divide it). Default: per-slot "
+                         "max_len reservation")
+    ap.add_argument("--num-pages", type=int, default=None,
+                    help="pool capacity in pages incl. the reserved null "
+                         "page (default: same token capacity as the slot "
+                         "cache; smaller overcommits — the point of "
+                         "paging). Needs --page-size")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="share read-only prompt-prefix pages across "
+                         "requests (hash-indexed, page-granular; needs "
+                         "--page-size)")
     ap.add_argument("--requests", type=int, default=4,
                     help="scripted request count (ignored with --stdin)")
     ap.add_argument("--prompt-len", type=int, default=8,
@@ -151,11 +174,21 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"under max_len={max_len}", file=sys.stderr)
         return 2
 
-    engine = Engine(
-        cfg, init_gpt2_params(cfg, seed=args.seed),
-        EngineConfig(num_slots=args.num_slots, max_len=max_len,
-                     temperature=args.temperature, top_k=args.top_k),
-        seed=args.seed)
+    try:
+        engine = Engine(
+            cfg, init_gpt2_params(cfg, seed=args.seed),
+            EngineConfig(num_slots=args.num_slots, max_len=max_len,
+                         temperature=args.temperature, top_k=args.top_k,
+                         page_size=args.page_size,
+                         num_pages=args.num_pages,
+                         prefix_cache=args.prefix_cache),
+            seed=args.seed)
+    except ValueError as e:
+        # bad pool geometry (page_size vs max_len/block_k, undersized
+        # num_pages, prefix-cache without pages) is a usage error, not a
+        # crash: the engine's message says exactly what to fix
+        print(f"apex-tpu-serve: {e}", file=sys.stderr)
+        return 2
 
     # one Telemetry owns the whole observability lifecycle: event mirror
     # (--telemetry-jsonl), span tracer install/restore + Chrome-trace

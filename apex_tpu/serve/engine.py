@@ -1,13 +1,13 @@
-"""AOT prefill + one-jit decode over the static KV cache.
+"""AOT prefill + one-jit decode over the static KV cache (slot or paged).
 
 The engine owns three compiled artifacts and NOTHING else touches the
 device:
 
 - ``decode_step`` — ONE jitted function, ``[num_slots]`` tokens in,
   ``[num_slots]`` sampled tokens out. Admission, completion, eviction, and
-  backfill all happen by changing *values* (masks, lengths), so the jit
-  cache holds exactly one entry for the life of the engine — asserted by
-  tier-1 (``Engine.decode_traces``).
+  backfill all happen by changing *values* (masks, lengths, page-table
+  rows), so the jit cache holds exactly one entry for the life of the
+  engine — asserted by tier-1 (``Engine.decode_traces``).
 - ``prefill`` — a ``lax.scan`` of the *same* single-token forward over the
   prompt positions, at the same ``[num_slots]`` width (non-admitted slots
   mask their writes). One compile per pow2 prompt-length bucket. Because
@@ -17,6 +17,25 @@ device:
   "prefill path" to drift from.
 - ``evict`` — a mask-shaped length reset (kv_cache.evict_slots), one
   compile total.
+
+**Paged mode** (``EngineConfig(page_size=...)``) swaps the per-slot
+``max_len`` reservation for a shared block pool
+(:class:`~apex_tpu.serve.kv_cache.PagedKVCache`): the per-slot page table
+is DATA threaded through the same compiled calls, host-side allocation
+lives in :mod:`apex_tpu.serve.paging`, and the attention chunk arithmetic
+is shared with the slot path — so a paged engine is **bit-exact in fp32
+against the slot engine** on identical request traces at the same
+``block_k`` (the slot cache is the oracle in tier-1; the default chunk
+is tuned per layout, so pin ``block_k`` for bitwise comparison). With ``prefix_cache=True`` a hash-based prefix
+index shares read-only prompt pages across requests: a request whose
+prompt prefix is already resident skips prefill for those pages (the
+scan covers only the tail; a partially-used boundary page is
+copied-on-write first), which is what removes the repeated fleet-wide
+system-prompt prefill. Pages for a request's whole admitted budget are
+reserved at admission, so decode can never page-fault mid-stream —
+conservative, but it keeps admission the single choke point
+(``serve_page_alloc_fail`` accounts the stall when the pool is the
+bottleneck).
 
 Sampling (temperature / top-k, greedy at ``temperature=0``) runs inside
 the jitted step under a threaded PRNG key: the key is part of engine
@@ -39,9 +58,10 @@ import numpy as np
 
 from apex_tpu.models.gpt2 import GPT2Config, gpt2_token_forward
 from apex_tpu.ops.pallas.tiling import pow2_ceil
-from apex_tpu.serve import kv_cache
+from apex_tpu.serve import kv_cache, paging
 from apex_tpu.serve.attention import resolve_block_k
-from apex_tpu.serve.kv_cache import KVCache, init_cache
+from apex_tpu.serve.kv_cache import init_cache, init_paged_cache
+from apex_tpu.serve.paging import PagePool, PrefixIndex
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,6 +73,16 @@ class EngineConfig:
     temperature: float = 1.0           # 0 => greedy argmax
     top_k: int = 0                     # 0 => full vocab
     block_k: Optional[int] = None      # decode-attention KV chunk (tuned)
+    # paged KV pool: tokens per page (None => per-slot slot cache). Must
+    # divide max_len; the tuned decode_attention block_k must divide it.
+    page_size: Optional[int] = None
+    # pool capacity in pages INCLUDING the reserved null page. Default
+    # num_slots * (max_len / page_size) + 1 — same token capacity as the
+    # slot cache; size it SMALLER to overcommit (the point of paging:
+    # mixed-length traffic shares the pool).
+    num_pages: Optional[int] = None
+    # hash-based prompt-prefix sharing across requests (paged mode only)
+    prefix_cache: bool = False
     # keep per-position prefill logits (parity tests / scoring). O(P*B*V)
     # memory — leave False for real vocabularies.
     keep_prefill_logits: bool = False
@@ -77,12 +107,38 @@ class Engine:
             raise ValueError(
                 f"max_len={self.max_len} exceeds the model's "
                 f"n_positions={model_cfg.n_positions}")
+        self._paged = config.page_size is not None
+        if self._paged:
+            ps = int(config.page_size)
+            if ps <= 0 or self.max_len % ps:
+                raise ValueError(
+                    f"page_size={config.page_size} must be positive and "
+                    f"divide max_len={self.max_len}")
+            self._max_pages = self.max_len // ps
+            self._num_pages = int(
+                config.num_pages
+                or config.num_slots * self._max_pages + 1)
+            if self._num_pages < self._max_pages + 1:
+                raise ValueError(
+                    f"num_pages={self._num_pages} cannot hold one "
+                    f"full-context request plus the null page (need "
+                    f">= {self._max_pages + 1})")
+        elif config.prefix_cache:
+            raise ValueError(
+                "prefix_cache=True needs the paged pool: set page_size "
+                "(prefix sharing is page-granular)")
+        elif config.num_pages is not None:
+            raise ValueError("num_pages needs page_size (paged mode)")
         h, d = model_cfg.n_head, model_cfg.n_embd // model_cfg.n_head
         # resolve the tuned geometry ONCE at engine build (cache lookups
-        # at trace time inside scan would re-announce per position)
+        # at trace time inside scan would re-announce per position);
+        # paged mode validates block_k against page_size here — a tuned
+        # or explicit chunk that does not divide the page is a clear
+        # ValueError at build, never a bad gather at trace time
         self.block_k = resolve_block_k(self.max_len, h, d,
                                        model_cfg.compute_dtype,
-                                       config.block_k)
+                                       config.block_k,
+                                       page_size=config.page_size)
         self._init_state(seed)
 
         # trace counters: tier-1 asserts decode_traces == 1 across a full
@@ -126,14 +182,18 @@ class Engine:
     def _make_prefill(self, bucket: int):
         keep = self.config.keep_prefill_logits
 
-        def prefill_fn(cache, tokens, admit, prompt_lens, rng):
+        def prefill_fn(cache, tokens, admit, start, tail_lens, rng):
             self.prefill_traces += 1
             cache = kv_cache.reset_slots(cache, admit)
 
             def body(carry, p):
                 cache, last_logits = carry
-                write = admit & (p < prompt_lens)
-                positions = jnp.where(write, p, cache.lengths)
+                write = admit & (p < tail_lens)
+                # absolute position = start + scan step: with a prefix
+                # hit the scan covers only the tail, attending back over
+                # the shared pages (start == 0 and tail == prompt on the
+                # slot path — bit-identical to the pre-paging scan)
+                positions = jnp.where(write, start + p, cache.lengths)
                 logits, cache = self._token_step(
                     cache, tokens[:, p], positions, write)
                 last_logits = jnp.where(write[:, None], logits,
@@ -146,7 +206,7 @@ class Engine:
             (cache, last_logits), all_logits = jax.lax.scan(
                 body, (cache, init_logits),
                 jnp.arange(bucket, dtype=jnp.int32))
-            cache = kv_cache.set_lengths(cache, admit, prompt_lens)
+            cache = kv_cache.set_lengths(cache, admit, start + tail_lens)
             rng, sub = jax.random.split(rng)
             first_tokens = self._sample(last_logits, sub)
             return cache, first_tokens, last_logits, all_logits, rng
@@ -162,7 +222,7 @@ class Engine:
         b = self.config.num_slots
         return (self.cache, jnp.zeros((b, bucket), jnp.int32),
                 jnp.zeros((b,), bool), jnp.zeros((b,), jnp.int32),
-                self.rng)
+                jnp.zeros((b,), jnp.int32), self.rng)
 
     def aot_compile(self, prompt_buckets: Sequence[int] = ()) -> "Engine":
         """Lower + compile decode (and the given prompt-length buckets)
@@ -171,7 +231,7 @@ class Engine:
         Each fresh compile publishes its static XLA memory reservation as
         an ``hbm_snapshot`` event (``apex_tpu.monitor.memory``) — the
         serving AOT points are where the engine's HBM budget is decided,
-        and the paged-KV ROADMAP item needs them on the record.
+        and the paged-vs-slot capacity comparison reads them.
         """
         from apex_tpu.monitor.memory import publish_compiled_memory
 
@@ -181,6 +241,7 @@ class Engine:
             publish_compiled_memory(
                 "serve_decode", self._decode_aot,
                 num_slots=self.config.num_slots, max_len=self.max_len,
+                page_size=self.config.page_size or 0,
                 kv_cache_bytes=self.kv_cache_bytes)
         for bucket in prompt_buckets:
             bucket = pow2_ceil(int(bucket))
@@ -200,21 +261,69 @@ class Engine:
         :meth:`reset` so a drain/restart can never miss a field)."""
         h = self.model_cfg.n_head
         d = self.model_cfg.n_embd // h
-        self.cache: KVCache = init_cache(
-            self.model_cfg.n_layer, self.config.num_slots, self.max_len,
-            h, d, self.model_cfg.compute_dtype)
+        b = self.config.num_slots
+        if self._paged:
+            ps = int(self.config.page_size)
+            self.cache: Any = init_paged_cache(
+                self.model_cfg.n_layer, b, self.max_len, ps,
+                self._num_pages, h, d, self.model_cfg.compute_dtype)
+            self.pool: Optional[PagePool] = PagePool(self._num_pages, ps)
+            self.prefix: Optional[PrefixIndex] = \
+                PrefixIndex(ps) if self.config.prefix_cache else None
+            self._page_table = np.zeros((b, self._max_pages), np.int32)
+            self._slot_pages = [[] for _ in range(b)]
+            # per-slot admitted token capacity (pages reserved at
+            # admission × page_size); slot engines use max_len flat
+            self._slot_capacity = np.zeros((b,), np.int64)
+        else:
+            self.cache = init_cache(
+                self.model_cfg.n_layer, b, self.max_len, h, d,
+                self.model_cfg.compute_dtype)
+            self.pool = None
+            self.prefix = None
+            self._slot_pages = [[] for _ in range(b)]
+            self._slot_capacity = np.full((b,), self.max_len, np.int64)
         self.rng = jax.random.PRNGKey(seed)
-        self.last_tokens = np.zeros((self.config.num_slots,), np.int32)
+        self.last_tokens = np.zeros((b,), np.int32)
         # host mirror of cache.lengths (advanced deterministically by
         # prefill/decode/evict) — lets decode_step enforce the context
         # bound without a per-step device fetch
-        self._host_lengths = np.zeros((self.config.num_slots,), np.int64)
+        self._host_lengths = np.zeros((b,), np.int64)
+        # prefix-cache accounting (tier-1 asserts a prefix hit SKIPS
+        # prefill work via these, not via wall clock)
+        self.prefill_calls = 0           # host prefill() invocations
+        self.prefill_requests = 0        # slot-prompts prefilled
+        self.prefill_scanned_tokens = 0  # scan steps actually paid
+        self.prefix_hits = 0             # prompts that reused >=1 page
+        self.prefix_hit_tokens = 0       # tokens served from the index
+        self.last_prefill_stats: Dict[int, Dict[str, int]] = {}
 
-    def reset(self, seed: int = 0) -> "Engine":
+    def reset(self, seed: int = 0, *,
+              keep_prefix_cache: bool = False) -> "Engine":
         """Drop all serving state — empty cache, fresh PRNG stream — while
         keeping every compiled artifact (the jits close over params only).
         A server drain/restart costs zero recompiles; tests reuse one
-        compiled engine across scenarios."""
+        compiled engine across scenarios.
+
+        Paged engines reset the page-pool free list and the prefix index
+        too (a leaked refcount would poison the next scenario — tier-1
+        regression-tests this). ``keep_prefix_cache=True`` (warm restart)
+        instead releases every slot's page references but keeps the pool
+        bytes and the index: shared prefix pages are read-only, so a
+        crash cannot have corrupted them, and recovery re-prefills only
+        the unshared tail of each surviving slot.
+        """
+        if keep_prefix_cache and self._paged and self.prefix is not None:
+            b = self.config.num_slots
+            for slot in range(b):
+                self._release_slot_pages(slot)
+            self.cache = self.cache.replace(
+                lengths=jnp.zeros((b,), jnp.int32))
+            self.rng = jax.random.PRNGKey(seed)
+            self.last_tokens = np.zeros((b,), np.int32)
+            self._host_lengths = np.zeros((b,), np.int64)
+            self.last_prefill_stats = {}
+            return self
         self._init_state(seed)
         return self
 
@@ -250,8 +359,71 @@ class Engine:
         self.rng = jnp.asarray(np.asarray(state["rng"], np.uint32))
         self.last_tokens = np.asarray(state["last_tokens"], np.int32)
 
+    def paging_state(self) -> Optional[Dict[str, Any]]:
+        """The page-accounting view a tick journal records (None for a
+        slot engine): per-slot page tables, pool refcounts, and the
+        prefix-index size — the postmortem answer to "where did the HBM
+        go" and the integrity cross-check for paged recovery."""
+        if not self._paged:
+            return None
+        return {
+            "page_size": int(self.config.page_size),
+            "num_pages": self._num_pages,
+            "free_pages": self.pool.free_count,
+            "refcounts": list(self.pool.refcount),
+            "page_table": self._page_table.tolist(),
+            "slot_capacity": self._slot_capacity.tolist(),
+            "prefix_entries": len(self.prefix) if self.prefix else 0,
+        }
+
+    # ---------------------------------------------------- page planning
+    def _release_slot_pages(self, slot: int) -> None:
+        """Drop the slot's page references (completion, eviction, or the
+        re-prefill prologue); index-pinned prefix pages survive."""
+        if not self._paged:
+            return
+        for page in self._slot_pages[slot]:
+            self.pool.release(page)
+        self._slot_pages[slot] = []
+        self._page_table[slot, :] = paging.NULL_PAGE
+        self._slot_capacity[slot] = 0
+
+    def admission_page_cost(self, tokens: Sequence[int], budget: int,
+                            pending: int = 0,
+                            protect: Optional[set] = None) -> Optional[int]:
+        """Admission probe: fresh pages admitting ``tokens`` with
+        ``budget`` new-token headroom would allocate, or ``None`` when
+        the pool (free list + LRU-evictable prefix pages) cannot cover
+        them on top of ``pending`` pages already promised to earlier
+        members of the same admission batch. ``protect`` (a set the
+        scheduler threads through a batch of probes — the only mutation)
+        accumulates every probed member's prefix-hit pages: a page one
+        member plans to share must not count as evictable headroom for
+        a later member, or prefill's eviction (which protects the whole
+        batch's hits) would free fewer pages than the probes assumed
+        and fail allocation mid-batch. Never touches the pool — the
+        scheduler probes before popping a request. Slot engines always
+        fit (cost 0)."""
+        if not self._paged:
+            return 0
+        plan = paging.plan_admission(
+            tokens, budget, self.max_len, int(self.config.page_size),
+            self.prefix, touch=False)
+        hits = {pg for _, pg in plan["hits"]}
+        protect_all = hits | (protect or set())
+        avail = self.pool.free_count
+        if self.prefix is not None:
+            avail += self.prefix.evictable(self.pool, protect_all)
+        if plan["new_pages"] + pending > avail:
+            return None
+        if protect is not None:
+            protect.update(hits)
+        return plan["new_pages"]
+
     # ------------------------------------------------------------- calls
-    def prefill(self, prompts: Dict[int, Sequence[int]]):
+    def prefill(self, prompts: Dict[int, Sequence[int]], *,
+                budgets: Optional[Dict[int, int]] = None,
+                cacheable: Optional[Dict[int, int]] = None):
         """Insert ``{slot: prompt token ids}`` in one compiled call.
 
         Pads every prompt to the shared pow2 bucket, resets the target
@@ -260,6 +432,18 @@ class Engine:
         slot's first generated token. Returns ``(first_tokens [B],
         last_logits [B, vocab], all_logits [P, B, vocab] | None)``; only
         the admitted slots' rows are meaningful.
+
+        Paged mode: ``budgets[slot]`` (default: worst case ``max_len -
+        len(prompt)``) sizes the page reservation — pages for the whole
+        admitted budget are taken here so decode never allocates. With a
+        prefix index, the longest indexed prefix is shared read-only and
+        the scan covers only the tail (a partial boundary page is
+        copied-on-write); afterwards the prompt's full pages are inserted
+        into the index — ``cacheable[slot]`` caps how many leading tokens
+        are indexable (recovery passes the original prompt length so
+        generated-token pages never pin the index). Raises
+        :class:`~apex_tpu.serve.paging.PagePoolExhausted` when pages run
+        out — callers admit through :meth:`admission_page_cost` first.
         """
         if not prompts:
             raise ValueError("prefill needs at least one slot: prompt")
@@ -274,11 +458,78 @@ class Engine:
                 raise ValueError(
                     f"prompt of {len(toks)} tokens exceeds max_len="
                     f"{self.max_len}")
-        bucket = pow2_ceil(max_p)
+
+        starts = np.zeros((b,), np.int32)
+        tails: Dict[int, Sequence[int]] = dict(prompts)
+        self.last_prefill_stats = {}
+        if self._paged:
+            ps = int(self.config.page_size)
+            for slot in prompts:
+                # the slot may still hold pages (same-tick backfill
+                # defers the device-side evict; tests re-prefill
+                # directly) — release before re-planning
+                self._release_slot_pages(slot)
+            # two passes: plan every slot BEFORE any eviction, so one
+            # slot's LRU eviction can never free a page another batch
+            # member planned to share (the probe counted those hits —
+            # evicting them would make its page math wrong mid-batch)
+            plans = {}
+            for slot in sorted(prompts):
+                toks = prompts[slot]
+                budget = (budgets or {}).get(slot)
+                if budget is None:
+                    budget = self.max_len - len(toks)
+                plans[slot] = paging.plan_admission(
+                    toks, budget, self.max_len, ps, self.prefix,
+                    touch=True)
+            protect_all = {pg for plan in plans.values()
+                           for _, pg in plan["hits"]}
+            for slot in sorted(prompts):
+                plan = plans[slot]
+                shared = [pg for _, pg
+                          in plan["hits"][:plan["shared_pages"]]]
+                if plan["new_pages"] > self.pool.free_count \
+                        and self.prefix is not None:
+                    self.prefix.evict(
+                        self.pool,
+                        plan["new_pages"] - self.pool.free_count,
+                        protect=protect_all)
+                fresh = self.pool.alloc(plan["new_pages"])
+                for pg in shared:
+                    self.pool.retain(pg)
+                if plan["cow_src"] is not None:
+                    # copy-on-write: the tail starts mid-page, so the
+                    # slot gets its own writable copy of the boundary
+                    # page (one compiled op; identical bytes)
+                    self.cache = kv_cache.copy_page(
+                        self.cache, plan["cow_src"], fresh[0])
+                row = shared + fresh
+                self._page_table[slot, :] = paging.NULL_PAGE
+                self._page_table[slot, :len(row)] = row
+                self._slot_pages[slot] = row
+                self._slot_capacity[slot] = plan["total_pages"] * ps
+                starts[slot] = plan["use"]
+                tails[slot] = plan["tail"]
+                if plan["use"]:
+                    self.prefix_hits += 1
+                    self.prefix_hit_tokens += plan["use"]
+                self.last_prefill_stats[slot] = {
+                    "hit_tokens": plan["use"],
+                    "hit_pages": plan["shared_pages"],
+                    "scanned": len(plan["tail"]),
+                }
+            self.cache = self.cache.replace(
+                page_table=jnp.asarray(self._page_table))
+        else:
+            for slot, toks in prompts.items():
+                self.last_prefill_stats[slot] = {
+                    "hit_tokens": 0, "hit_pages": 0, "scanned": len(toks)}
+
+        bucket = pow2_ceil(max(len(t) for t in tails.values()))
         tokens = np.zeros((b, bucket), np.int32)
         admit = np.zeros((b,), bool)
         lens = np.zeros((b,), np.int32)
-        for slot, toks in prompts.items():
+        for slot, toks in tails.items():
             tokens[slot, :len(toks)] = np.asarray(toks, np.int32)
             admit[slot] = True
             lens[slot] = len(toks)
@@ -289,10 +540,22 @@ class Engine:
                 bucket, self._make_prefill(bucket))
         self.cache, first, last_logits, all_logits, self.rng = fn(
             self.cache, jnp.asarray(tokens), jnp.asarray(admit),
-            jnp.asarray(lens), self.rng)
+            jnp.asarray(starts), jnp.asarray(lens), self.rng)
+        self.prefill_calls += 1
+        self.prefill_requests += len(prompts)
+        self.prefill_scanned_tokens += int(bucket)
         first_np = np.asarray(first)
         self.last_tokens = np.where(admit, first_np, self.last_tokens)
-        self._host_lengths = np.where(admit, lens, self._host_lengths)
+        full_lens = starts + lens
+        self._host_lengths = np.where(admit, full_lens,
+                                      self._host_lengths)
+        if self._paged and self.prefix is not None:
+            for slot, toks in prompts.items():
+                upto = (cacheable or {}).get(slot, len(toks))
+                row = self._slot_pages[slot]
+                for i, h in enumerate(
+                        paging.chunk_hashes(list(toks[:upto]), ps)):
+                    self.prefix.insert(h, row[i], self.pool)
         return first_np, last_logits, all_logits
 
     def decode_step(self, last_tokens, active):
@@ -301,14 +564,17 @@ class Engine:
         ``active`` ``[num_slots]`` bool. Returns ``(next_tokens
         np.ndarray, logits [num_slots, vocab] device array)``."""
         act_np = np.asarray(active, bool)
-        full = act_np & (self._host_lengths >= self.max_len)
+        full = act_np & (self._host_lengths >= self._slot_capacity)
         if full.any():
-            # the cache write would silently clip to max_len - 1 and
-            # corrupt the newest K/V row — refuse instead; the scheduler
-            # terminates at context-full before ever reaching this
+            # the cache write would silently clip (slot cache) or land in
+            # an unreserved page (paged) and corrupt the newest K/V row —
+            # refuse instead; the scheduler terminates at context-full /
+            # budget before ever reaching this
             raise ValueError(
-                f"slot(s) {np.flatnonzero(full).tolist()} are at "
-                f"max_len={self.max_len}; evict or raise max_len before "
+                f"slot(s) {np.flatnonzero(full).tolist()} are at their "
+                f"admitted capacity "
+                f"{self._slot_capacity[full].tolist()} (max_len="
+                f"{self.max_len}); evict or raise max_len before "
                 f"decoding further")
         fn = self._decode_aot or self._decode
         lt = jnp.asarray(np.asarray(last_tokens, np.int32))
@@ -321,21 +587,45 @@ class Engine:
         return next_np, logits
 
     def evict(self, slots) -> None:
-        """Free the given slot indices (mask-shaped op, compiled once)."""
+        """Free the given slot indices (mask-shaped op, compiled once);
+        paged engines return the slots' page references to the pool
+        (index-pinned prefix pages stay resident)."""
         mask = np.zeros((self.config.num_slots,), bool)
         mask[np.asarray(list(slots), np.int64)] = True
         self.cache = kv_cache.evict_slots(self.cache, jnp.asarray(mask))
         self._host_lengths = np.where(mask, 0, self._host_lengths)
+        if self._paged:
+            for slot in np.flatnonzero(mask):
+                self._release_slot_pages(int(slot))
 
     @property
     def lengths(self) -> np.ndarray:
         return np.asarray(self.cache.lengths)
 
     @property
+    def paged(self) -> bool:
+        return self._paged
+
+    @property
+    def resident_tokens(self) -> int:
+        """Tokens currently resident in the cache across all slots."""
+        return int(self._host_lengths.sum())
+
+    @property
+    def free_page_frac(self) -> float:
+        """Fraction of the allocatable pool currently free (1.0 for slot
+        engines — they have no pool to pressure)."""
+        if not self._paged:
+            return 1.0
+        return self.pool.free_count / max(self.pool.capacity, 1)
+
+    @property
     def kv_cache_bytes(self) -> int:
-        """Resident bytes of the static KV cache — the number the paged
-        pool (ROADMAP item 2) must beat; stamped into the serving AOT
-        ``hbm_snapshot`` so captures carry it."""
+        """Resident bytes of the KV buffers — the slot cache's
+        ``num_slots * max_len`` reservation, or the paged pool's
+        ``num_pages * page_size``; stamped into the serving AOT
+        ``hbm_snapshot`` and the bench's
+        ``resident_tokens_per_hbm_byte`` so captures carry it."""
         return int(self.cache.k.nbytes) + int(self.cache.v.nbytes)
 
 

@@ -1,15 +1,27 @@
-"""Static-shape, slot-addressed KV cache.
+"""Static-shape KV caches: slot-addressed, and the paged block pool.
 
-One buffer pair per layer, all layers stacked on a leading axis:
-``k``/``v`` are ``[n_layer, num_slots, max_len, heads, head_dim]`` and
-``lengths`` is ``[num_slots]`` — the number of tokens resident per slot.
-The arrays never change shape for the lifetime of the engine; request
-admission, completion, and eviction only move *values* (a length reset, a
-masked token write), so the jitted decode step that closes over this
-pytree compiles exactly once.
+Two layouts, one contract — every array shape is fixed at engine build
+and request admission/completion/eviction only move *values*, so the
+jitted decode step that closes over either pytree compiles exactly once:
 
-All mutators are pure functions returning a new :class:`KVCache` (the
-engine's jitted callables donate nothing and alias nothing). Masked writes
+- :class:`KVCache` — per-slot reservation: ``k``/``v`` are
+  ``[n_layer, num_slots, max_len, heads, head_dim]`` plus per-slot
+  ``lengths``. Simple, but every slot pays ``max_len`` tokens of HBM
+  whatever its request actually uses.
+- :class:`PagedKVCache` — a shared block pool: ``k``/``v`` are
+  ``[n_layer, num_pages, page_size, heads, head_dim]`` plus a per-slot
+  page table ``[num_slots, max_pages_per_slot]`` of pool indices and the
+  same ``lengths``. A slot's virtual key axis is its page-table row laid
+  end to end; position ``p`` lives at ``(page_table[slot, p // page_size],
+  p % page_size)``. Page indices are DATA (host-allocated in
+  :mod:`apex_tpu.serve.paging`, threaded through the compiled call),
+  never shapes — so paging multiplies resident requests per HBM byte
+  without touching the one-compile invariant. Page 0 is the reserved
+  null page: masked-off writes are routed there and unmapped table
+  entries read its zeros (discarded by the attention reachability mask).
+
+All mutators are pure functions returning a new cache (the engine's
+jitted callables donate nothing and alias nothing). Masked writes
 read-modify-write the existing token so an inactive slot's bytes are
 untouched — slot isolation is structural, not best-effort.
 """
@@ -106,10 +118,123 @@ def set_lengths(cache: KVCache, mask: jax.Array,
 
 
 # host-callable eviction: ONE jitted (mask-shaped) op, compiled once per
-# engine — freeing a slot between decode steps cannot recompile anything
+# engine (once per cache pytree structure — slot and paged engines each
+# hold their own entry) — freeing a slot between decode steps cannot
+# recompile anything
 @jax.jit
-def evict_slots(cache: KVCache, mask: jax.Array) -> KVCache:
+def evict_slots(cache, mask: jax.Array):
     """Free masked slots. Data is left in place; only ``lengths`` moves —
     the attention mask (``key_pos <= position``) makes the stale rows
-    unreachable, and the next insert overwrites them."""
+    unreachable, and the next insert overwrites them. Works on either
+    cache layout (it only touches ``lengths``; a paged slot's page
+    *indices* are host bookkeeping, freed by the allocator)."""
     return reset_slots(cache, mask)
+
+
+# ------------------------------------------------------- paged block pool
+
+
+@flax.struct.dataclass
+class PagedKVCache:
+    """Pytree of the paged serving cache; see module docstring."""
+
+    k: jax.Array           # [n_layer, num_pages, page_size, heads, head_dim]
+    v: jax.Array           # same shape as k
+    lengths: jax.Array     # [num_slots] int32 — tokens resident per slot
+    page_table: jax.Array  # [num_slots, max_pages_per_slot] int32
+
+    @property
+    def n_layer(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_slots(self) -> int:
+        return self.page_table.shape[0]
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        return self.page_table.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        """Per-slot virtual context bound (the page-table row laid flat)."""
+        return self.page_size * self.max_pages_per_slot
+
+
+def init_paged_cache(n_layer: int, num_slots: int, max_len: int,
+                     page_size: int, num_pages: int, heads: int,
+                     head_dim: int, dtype: Any = jnp.float32) -> PagedKVCache:
+    """Allocate an empty page pool. ``max_len`` (must be a multiple of
+    ``page_size``) bounds every request's total context; ``num_pages``
+    bounds the *pool* — sizing it below ``num_slots * max_len /
+    page_size`` (+1 for the null page) is the point: mixed-length
+    traffic shares the pool instead of each slot reserving ``max_len``.
+    """
+    if max_len % page_size:
+        raise ValueError(
+            f"page_size={page_size} must divide max_len={max_len} (a "
+            f"slot's virtual key axis is whole pages laid end to end)")
+    max_pages = max_len // page_size
+    if num_pages < max_pages + 1:
+        raise ValueError(
+            f"num_pages={num_pages} cannot hold even one full-context "
+            f"request: need max_len/page_size + 1 null page = "
+            f"{max_pages + 1}")
+    shape = (n_layer, num_pages, page_size, heads, head_dim)
+    return PagedKVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        lengths=jnp.zeros((num_slots,), jnp.int32),
+        page_table=jnp.zeros((num_slots, max_pages), jnp.int32))
+
+
+def paged_write_token(cache: PagedKVCache, layer: int, k_tok: jax.Array,
+                      v_tok: jax.Array, positions: jax.Array,
+                      mask: jax.Array) -> PagedKVCache:
+    """The paged analog of :func:`write_token`: append one token's K/V
+    per slot at virtual position ``positions[slot]`` — physical page
+    ``page_table[slot, pos // page_size]``, row ``pos % page_size`` —
+    where ``mask[slot]``.
+
+    Masked-off slots are routed to the null page (page 0) and write back
+    its current row bit-for-bit: a stale page-table entry on an inactive
+    slot can therefore never collide with a live slot's append inside
+    the same scatter. Live slots' target pages are uniquely owned by
+    construction (the host allocator never maps one writable page into
+    two tables), so the scatter indices of real writes never alias.
+    """
+    ps = cache.page_size
+    pos = jnp.clip(positions.astype(jnp.int32), 0, cache.max_len - 1)
+    rows = jnp.arange(cache.num_slots)
+    pages = cache.page_table[rows, pos // ps]          # [B]
+    pages = jnp.where(mask, pages, 0)
+    offs = jnp.where(mask, pos % ps, 0)
+    out = {}
+    for name, tok in (("k", k_tok), ("v", v_tok)):
+        buf = getattr(cache, name)                     # [L, P, S, h, d]
+        cur = buf[layer, pages, offs]                  # [B, h, d]
+        new = jnp.where(mask[:, None, None], tok.astype(buf.dtype), cur)
+        out[name] = buf.at[layer, pages, offs].set(new)
+    return cache.replace(k=out["k"], v=out["v"])
+
+
+# host-callable copy-on-write: ONE jitted op (page indices are traced
+# scalars), compiled once per engine — sharing a partially-used prefix
+# page costs a page copy, never a recompile
+@jax.jit
+def copy_page(cache: PagedKVCache, src, dst) -> PagedKVCache:
+    """Copy page ``src`` onto page ``dst`` across every layer, both K and
+    V — the copy-on-write that gives a slot its own writable copy of a
+    shared prefix page whose tail it must append into."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return cache.replace(
+        k=cache.k.at[:, dst].set(cache.k[:, src]),
+        v=cache.v.at[:, dst].set(cache.v[:, src]))

@@ -78,12 +78,17 @@ class AdmissionController:
 
     Degradation fires only when ``degraded_max_new_tokens`` is set: once
     the overload signal — ``queue_depth >= queue_high`` (default
-    ``ceil(queue_high_frac * max_queue)``) or HBM allocator usage at
+    ``ceil(queue_high_frac * max_queue)``), HBM allocator usage at
     ``hbm_frac_high`` of the device limit (fed from the PR-6
-    ``hbm_snapshot`` sampling via :meth:`note_hbm`) — holds for
-    ``sustain_ticks`` consecutive ticks, newly admitted requests have
-    ``max_new_tokens`` clamped until the signal clears for the same
-    number of ticks. A one-tick spike never flips the mode.
+    ``hbm_snapshot`` sampling via :meth:`note_hbm`), or the paged KV
+    pool's free-page fraction at or below ``pool_frac_low`` (fed from
+    the scheduler via :meth:`note_pool`) — holds for ``sustain_ticks``
+    consecutive ticks, newly admitted requests have ``max_new_tokens``
+    clamped until the signal clears for the same number of ticks. A
+    one-tick spike never flips the mode. Clamping admitted budgets is
+    doubly effective on a paged engine: the budget sizes the page
+    reservation, so degradation directly relieves the pool pressure
+    that triggered it.
     """
 
     def __init__(self, max_queue: Optional[int] = None,
@@ -92,7 +97,8 @@ class AdmissionController:
                  queue_high: Optional[int] = None,
                  queue_high_frac: float = 0.75,
                  sustain_ticks: int = 4,
-                 hbm_frac_high: float = 0.92):
+                 hbm_frac_high: float = 0.92,
+                 pool_frac_low: float = 0.05):
         if shed_policy not in SHED_POLICIES:
             raise ValueError(
                 f"shed_policy {shed_policy!r} not in {SHED_POLICIES}")
@@ -109,10 +115,12 @@ class AdmissionController:
         self.queue_high = queue_high
         self.sustain_ticks = max(1, int(sustain_ticks))
         self.hbm_frac_high = float(hbm_frac_high)
+        self.pool_frac_low = float(pool_frac_low)
         self.degraded = False
         self._hot_ticks = 0
         self._cool_ticks = 0
         self._hbm_frac: Optional[float] = None
+        self._pool_free_frac: Optional[float] = None
 
     # ---- submit-time decisions -----------------------------------------
     def on_submit(self, queue, req) -> Tuple[str, Optional[Any]]:
@@ -141,8 +149,18 @@ class AdmissionController:
         if limit:
             self._hbm_frac = stats.get("bytes_in_use", 0) / float(limit)
 
+    def note_pool(self, free_frac: Optional[float]) -> None:
+        """Feed the paged KV pool's free-page fraction (the scheduler
+        forwards ``Engine.free_page_frac`` per tick on paged engines) —
+        the low-watermark overload signal for KV capacity."""
+        if free_frac is not None:
+            self._pool_free_frac = float(free_frac)
+
     def overloaded(self, queue_depth: int) -> bool:
         if self.queue_high is not None and queue_depth >= self.queue_high:
+            return True
+        if (self._pool_free_frac is not None
+                and self._pool_free_frac <= self.pool_frac_low):
             return True
         return (self._hbm_frac is not None
                 and self._hbm_frac >= self.hbm_frac_high)
@@ -221,7 +239,7 @@ class TickJournal:
                 slots.append({"request_id": str(ent["request_id"]),
                               "prompt": list(ent["prompt"]),
                               "generated": list(ent["generated"])})
-        return {
+        out = {
             "schema": JOURNAL_SCHEMA_VERSION,
             "decode_steps": snap["decode_steps"],
             "decode_tokens": snap["decode_tokens"],
@@ -231,6 +249,13 @@ class TickJournal:
                         "prompt_tokens": len(r.tokens)}
                        for r in snap["queued"]],
         }
+        # paged engines: page tables + pool refcounts + prefix-index size
+        # (docs/serving.md "Paged KV pool" — the postmortem answer to
+        # "where did the HBM go"; absent entirely for slot engines so
+        # pre-paging journal consumers see an unchanged document)
+        if snap.get("paging") is not None:
+            out["paging"] = snap["paging"]
+        return out
 
     def save(self, path: Optional[str] = None) -> str:
         """Persist the journal atomically: stage to ``.tmp``, publish

@@ -37,6 +37,14 @@ picks them up with zero wiring:
   overload flipped graceful degradation on/off
 - ``serve_engine_restart``    {restarts, resumed_slots, requeued, error}
   — a warm restart recovered the fleet after a fatal tick exception
+- ``serve_prefix_hit``        {request_id, slot, hit_tokens, hit_pages,
+  scanned_tokens} — an admission reused resident read-only prefix pages
+  and skipped prefilling them (paged engines with ``prefix_cache``)
+- ``serve_page_alloc_fail``   {seconds, queue_depth, free_page_frac} —
+  admission stalled because the paged KV pool had no free pages;
+  ``seconds`` (the whole head-of-queue stall window) is a timed loss
+  cause distinct from plain ``serve_queue_wait`` — capacity lost to KV
+  bytes, not to slot count
 
 Aborts can be driven deterministically by the resilience
 :class:`~apex_tpu.resilience.fault_injection.FaultInjector`
@@ -161,6 +169,9 @@ class ServeStats:
     total_new_tokens: int       # includes each request's prefill-sampled
     wall_s: float               # first token
     restarts: int = 0           # warm restarts survived (recover() calls)
+    admitted: int = 0           # requests that reached a slot
+    prefix_hits: int = 0        # admissions that reused resident pages
+    peak_resident_tokens: int = 0  # max cache tokens live at once
 
     def summary(self) -> Dict[str, Any]:
         lat = sorted(self.decode_step_s)
@@ -193,6 +204,15 @@ class ServeStats:
             "restarts": self.restarts,
             "decode_steps": self.decode_steps,
             "new_tokens": self.total_new_tokens,
+            # paged-pool effectiveness: what fraction of admissions were
+            # served partly from shared prefix pages, and the densest the
+            # cache ever got (the capacity number the paged pool
+            # multiplies; divide by the engine's kv_cache_bytes for the
+            # bench's resident_tokens_per_hbm_byte)
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": round(self.prefix_hits / self.admitted, 4)
+            if self.admitted else 0.0,
+            "peak_resident_tokens": self.peak_resident_tokens,
             # decode throughput: decode-produced tokens over decode-step
             # time ONLY — prefill-sampled first tokens ride TTFT, not this
             # rate, so the bench headline tracks the decode hot path and
@@ -255,6 +275,15 @@ class ServeScheduler:
         self.decode_steps = 0
         self.decode_step_s: List[float] = []
         self.decode_tokens = 0
+        self.admitted = 0             # requests that reached a slot
+        self.prefix_hits = 0          # admissions served partly from the
+        #                               paged prefix index
+        self.peak_resident_tokens = 0
+        # head-of-queue page-allocation stall window (paged engines):
+        # opened when admission is blocked on pool pages, closed + charged
+        # to serve_page_alloc_fail when pages free up (or at drain)
+        self._alloc_stall_t0: Optional[float] = None
+        self._alloc_stall_req: Optional[Request] = None
         self._to_evict: set = set()   # slots freed, device reset pending
         self._t0: Optional[float] = None
 
@@ -287,6 +316,7 @@ class ServeScheduler:
                     # charged) wait so far is lost time and the
                     # rejection says so
                     self.queue.remove(victim)
+                    self._stall_head_removed(victim)
                     self._reject(victim, "shed",
                                  seconds=max(req.submit_t
                                              - victim.submit_t
@@ -324,30 +354,65 @@ class ServeScheduler:
     def _admit(self) -> None:
         """Fill free slots from the queue with ONE batched prefill call
         (per shared pow2 bucket) and record each admitted request's first
-        sampled token."""
+        sampled token.
+
+        Paged engines are probed FIRST (``Engine.admission_page_cost``):
+        a request whose page reservation does not fit stays at the head
+        of the queue — FIFO order holds, the stall is charged to
+        ``serve_page_alloc_fail`` once pages free up, and the batched
+        prefill below can never fail allocation mid-batch."""
         # caller holds self._lock (step())
         free = [i for i, r in enumerate(self.slots) if r is None]
         if not free or not self.queue:
             return
+        prior_stall = self._alloc_stall_t0
         batch: Dict[int, Request] = {}
+        pending_pages = 0
+        # prefix-hit pages promised to earlier batch members: a later
+        # probe must not count them as evictable headroom (the engine's
+        # batched prefill protects the whole batch's hits)
+        pending_protect: set = set()
+        stalled = False
         while free and self.queue:
+            head = self.queue[0]
+            # the admitted budget (degradation clamp included) sizes the
+            # page reservation, so probe with the value admission grants
+            budget = (self.admission.clamp(head.max_new_tokens)
+                      if self.admission is not None
+                      else head.max_new_tokens)
+            cost = self.engine.admission_page_cost(head.tokens, budget,
+                                                   pending_pages,
+                                                   protect=pending_protect)
+            if cost is None:
+                # head-of-line page stall: no slot membership change, the
+                # request waits for completions to free pages
+                stalled = True
+                break
+            pending_pages += cost
             slot = free.pop(0)
             req = self.queue.popleft()
             req.slot = slot
+            req.budget = budget
             self.slots[slot] = req
             batch[slot] = req
+        if batch and prior_stall is not None:
+            # the head that opened the window was admitted: charge its
+            # whole blocked span (an admission that merely rides along
+            # while the head STAYS blocked must not close — or reset —
+            # the window, so the true start is never lost)
+            self._end_alloc_stall()
+        if stalled and self._alloc_stall_t0 is None:
+            self._alloc_stall_t0 = time.perf_counter()
+            self._alloc_stall_req = self.queue[0]
+        if not batch:
+            return
         now = time.perf_counter()
         for slot, req in batch.items():
             req.admit_t = now
             req.state = "running"
-            # graceful degradation: under sustained overload the admitted
-            # token budget is clamped — shed work, not requests, until
-            # the queue drains
-            req.budget = (self.admission.clamp(req.max_new_tokens)
-                          if self.admission is not None
-                          else req.max_new_tokens)
             wait = max(now - req.submit_t - req.wait_charged, 0.0)
             req.wait_charged += wait
+            self.admitted += 1
             publish_event("serve_queue_wait", seconds=wait,
                           request_id=req.request_id)
             publish_event("serve_request_admitted",
@@ -360,9 +425,21 @@ class ServeScheduler:
                 sp["prefill"] = self.tracer.begin(
                     "prefill", parent=sp["root"], t0=now, slot=slot)
         first, _last_logits, _all = self.engine.prefill(
-            {slot: req.tokens for slot, req in batch.items()})
+            {slot: req.tokens for slot, req in batch.items()},
+            budgets={slot: req.budget for slot, req in batch.items()})
         t_first = time.perf_counter()
         for slot, req in batch.items():
+            hit = self.engine.last_prefill_stats.get(slot, {})
+            if hit.get("hit_tokens"):
+                # the shared-prefix win, per request: these tokens were
+                # served from resident read-only pages instead of being
+                # re-prefilled (the counted event the hit-rate audits)
+                self.prefix_hits += 1
+                publish_event("serve_prefix_hit",
+                              request_id=req.request_id, slot=slot,
+                              hit_tokens=hit["hit_tokens"],
+                              hit_pages=hit["hit_pages"],
+                              scanned_tokens=hit["scanned"])
             req.first_token_t = t_first
             sp = self._req_spans.get(req)
             if sp is not None:
@@ -372,6 +449,34 @@ class ServeScheduler:
                 sp["decode"] = self.tracer.begin(
                     "decode", parent=sp["root"], t0=t_first, slot=slot)
             self._accept_token(req, int(first[slot]))
+
+    def _end_alloc_stall(self) -> None:
+        """Close an open page-allocation stall window: the whole span the
+        queue head spent blocked on pool pages is lost serving time, and
+        the cause says so (a plain ``serve_queue_wait`` would blame slot
+        scarcity for what is a KV-capacity shortage)."""
+        # caller holds self._lock (_admit()/drain_and_reject()/run())
+        if self._alloc_stall_t0 is None:
+            return
+        stalled = max(time.perf_counter() - self._alloc_stall_t0, 0.0)
+        self._alloc_stall_t0 = None
+        self._alloc_stall_req = None
+        publish_event("serve_page_alloc_fail", level="warning",
+                      seconds=round(stalled, 6),
+                      queue_depth=len(self.queue),
+                      free_page_frac=round(self.engine.free_page_frac, 4))
+
+    def _stall_head_removed(self, req: Request) -> None:
+        """A queued request left the queue by a NON-admission path (shed,
+        abort, deadline expiry): when it is the head whose page stall
+        opened the window, close-and-charge the window now — the span it
+        spent blocked on pages is real lost capacity, but the idle span
+        after its departure is not, and a window left open here would
+        charge that whole idle span to ``serve_page_alloc_fail`` at the
+        next admission."""
+        # caller holds self._lock (submit()/abort()/_sweep_deadlines())
+        if req is self._alloc_stall_req:
+            self._end_alloc_stall()
 
     # -------------------------------------------------------- lifecycle
     def _accept_token(self, req: Request, tok: int) -> None:
@@ -456,6 +561,7 @@ class ServeScheduler:
             for req in list(self.queue):
                 if req.request_id == request_id:
                     self.queue.remove(req)
+                    self._stall_head_removed(req)
                     publish_event(
                         "serve_queue_wait",
                         seconds=max(time.perf_counter() - req.submit_t
@@ -480,6 +586,7 @@ class ServeScheduler:
             if req.deadline_ms is not None and \
                     (now - req.submit_t) * 1e3 > req.deadline_ms:
                 self.queue.remove(req)
+                self._stall_head_removed(req)
                 self._expire(req, now)
         for req in list(self.slots):
             if req is not None and req.deadline_ms is not None and \
@@ -545,6 +652,12 @@ class ServeScheduler:
             if self.admission is not None:
                 if self.memory is not None:
                     self.admission.note_hbm(self.memory.last)
+                if self.engine.paged:
+                    # pool occupancy is the serving-side memory-pressure
+                    # signal (the allocator stats above are process-wide):
+                    # a drained free list degrades admitted budgets just
+                    # like a deep queue does
+                    self.admission.note_pool(self.engine.free_page_frac)
                 flip = self.admission.on_tick(len(self.queue))
                 if flip is not None:
                     publish_event(
@@ -552,8 +665,17 @@ class ServeScheduler:
                         entered=flip, queue_depth=len(self.queue),
                         clamp=self.admission.degraded_max_new_tokens)
             self._admit()
+            self.peak_resident_tokens = max(
+                self.peak_resident_tokens, self.engine.resident_tokens)
             active = np.array([r is not None for r in self.slots], bool)
             if not active.any():
+                # no decode step will run this tick, so the end-of-tick
+                # eviction flush below is unreachable — flush HERE or a
+                # paged engine livelocks: pages of slots freed by the
+                # deadline sweep / an abort stay refcounted, the queue
+                # head's page probe keeps failing, and no decode step
+                # ever advances decode_steps toward max_steps
+                self._flush_evictions()
                 if self.journal is not None:
                     self._journal_tick()
                 return bool(self.queue)
@@ -569,6 +691,12 @@ class ServeScheduler:
             self.decode_steps += 1
             self.decode_step_s.append(dt)
             self.decode_tokens += int(active.sum())
+            # second residency sample, AFTER the append: a completing
+            # slot's final token is resident right now and gone before
+            # the next tick's sample — without this the true peak is
+            # systematically one token per completion low
+            self.peak_resident_tokens = max(
+                self.peak_resident_tokens, self.engine.resident_tokens)
             if self.tracer is not None:
                 if self._sched_span is None:
                     self._sched_span = self.tracer.begin(
@@ -604,6 +732,11 @@ class ServeScheduler:
             "decode_steps": self.decode_steps,
             "decode_tokens": self.decode_tokens,
             "engine": self.engine.sampling_state(),
+            # page accounting (None for slot engines): page tables +
+            # refcounts, for the postmortem journal and the paged-recovery
+            # integrity story — recovery itself re-derives allocation by
+            # re-prefilling, sharing whatever prefix pages survived
+            "paging": self.engine.paging_state(),
             "slots": [None if r is None else {
                 "req": r, "request_id": r.request_id,
                 # the prompt is immutable for the request's lifetime —
@@ -638,7 +771,13 @@ class ServeScheduler:
                     "(...)) — there is no snapshot to roll back to")
             snap = self.journal.snapshot
             self.restarts += 1
-            self.engine.reset()   # state drop; compiled artifacts kept
+            # state drop; compiled artifacts kept. Paged engines with a
+            # prefix index keep the pool bytes + index too: shared prefix
+            # pages are read-only (a crash cannot have torn them), so
+            # recovery re-prefills ONLY the unshared pages of each
+            # surviving slot — the re-prefill below hits the index for
+            # the prompt portion and pays just the generated tail
+            self.engine.reset(keep_prefix_cache=True)
             snap_ids = {id(ent["req"]) for ent in snap["slots"]
                         if ent is not None}
             # requeue: journaled order first, then post-snapshot arrivals
@@ -670,7 +809,14 @@ class ServeScheduler:
             self.queue = collections.deque(requeue)
             self.slots = [None] * self.engine.config.num_slots
             self._to_evict.clear()
+            # an open page-stall window is void: the rollback re-derives
+            # allocation, and the requeued head's wait is charged as
+            # queue time at its (re-)admission
+            self._alloc_stall_t0 = None
+            self._alloc_stall_req = None
             prefixes: Dict[int, List[int]] = {}
+            budgets: Dict[int, int] = {}
+            cacheable: Dict[int, int] = {}
             for slot, ent in enumerate(snap["slots"]):
                 if ent is None:
                     continue
@@ -684,12 +830,23 @@ class ServeScheduler:
                 # the cache must hold prompt + generated[:-1]: the last
                 # generated token is the NEXT decode input, not resident
                 prefixes[slot] = list(ent["prompt"]) + req.generated[:-1]
+                # page reservation for the REMAINING stream: the admitted
+                # budget minus tokens already generated (the re-prefilled
+                # tail counts as resident, not budget)
+                budget = req.budget if req.budget is not None \
+                    else req.max_new_tokens
+                budgets[slot] = max(budget - len(req.generated) + 1, 1)
+                # only the original prompt may enter the prefix index —
+                # generated-token pages are one stream's state, not a
+                # shareable prefix, and must not pin the index
+                cacheable[slot] = len(ent["prompt"])
             if prefixes:
                 # ONE prefill call, exactly like _admit: the engine pads
                 # every prefix to the shared pow2 bucket itself, so a
                 # mixed-length recovery pays at most one fresh bucket
                 # trace, never one per length class
-                self.engine.prefill(prefixes)
+                self.engine.prefill(prefixes, budgets=budgets,
+                                    cacheable=cacheable)
             self.engine.restore_sampling_state(snap["engine"],
                                                slots=sorted(prefixes))
             self.decode_steps = snap["decode_steps"]
@@ -739,6 +896,7 @@ class ServeScheduler:
         status. Returns the number drained."""
         n = 0
         with self._lock:
+            self._end_alloc_stall()
             now = time.perf_counter()
             while self.queue:
                 req = self.queue.popleft()
@@ -770,6 +928,7 @@ class ServeScheduler:
                             self.decode_steps >= max_steps:
                         break
                 with self._lock:
+                    self._end_alloc_stall()
                     for req in list(self.queue) + [r for r in self.slots
                                                    if r is not None]:
                         if req in self.queue:
@@ -793,4 +952,7 @@ class ServeScheduler:
                           total_new_tokens=sum(r["new_tokens"]
                                                for r in records),
                           wall_s=wall,
-                          restarts=self.restarts)
+                          restarts=self.restarts,
+                          admitted=self.admitted,
+                          prefix_hits=self.prefix_hits,
+                          peak_resident_tokens=self.peak_resident_tokens)

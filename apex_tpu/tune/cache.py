@@ -41,7 +41,10 @@ CODE_VERSIONS = {
     "softmax_causal_chunked": 1,
     "group_norm": 1,
     "flash_attention": 1,
-    "decode_attention": 1,
+    # v2: the paged KV pool added a page_size shape-key axis and the
+    # block_k-divides-page constraint — entries tuned against the v1
+    # slot-only geometry must not apply
+    "decode_attention": 2,
     "fused_adam": 1,
     "fused_sgd": 1,
     "fused_lamb": 1,

@@ -336,22 +336,34 @@ def _fa_build(shape, dtype, params, interpret=None):
 
 def _da_shape_key(shape) -> ShapeKey:
     # max_len keyed exactly (layout-defining static engine constant; the
-    # winner must divide it) — matches serve.attention.resolve_block_k
+    # winner must divide it) — matches serve.attention.resolve_block_k.
+    # page_size is a second exact geometry axis (0 = slot cache): a paged
+    # chunk must live inside one page, so a winner tuned at one page size
+    # cannot apply to another (or to the slot layout) — CODE_VERSIONS
+    # bumped to 2 when this axis landed so v1 entries invalidate.
     return (("max_len", int(shape["max_len"])),
+            ("page_size", int(shape.get("page_size", 0))),
             ("heads", int(shape["heads"])),
             ("d", int(shape["d"])))
+
+
+def _da_unit(shape) -> int:
+    """The span a chunk must divide: the page (paged) or the whole key
+    axis (slot cache)."""
+    ps = int(shape.get("page_size", 0))
+    return ps if ps else int(shape["max_len"])
 
 
 def _da_defaults(shape):
     from apex_tpu.ops.pallas.tiling import decode_attention_block
 
-    return {"block_k": decode_attention_block(int(shape["max_len"]))}
+    return {"block_k": decode_attention_block(_da_unit(shape))}
 
 
 def _da_candidates(shape):
-    L = int(shape["max_len"])
+    unit = _da_unit(shape)
     cands = [{"block_k": bk} for bk in (128, 256, 512, 1024, 2048)
-             if bk <= L and L % bk == 0]
+             if bk <= unit and unit % bk == 0]
     default = _da_defaults(shape)
     if default not in cands:
         cands.append(default)
@@ -360,20 +372,38 @@ def _da_candidates(shape):
 
 def _da_build(shape, dtype, params, interpret=None):
     import jax
-
-    from apex_tpu.serve.attention import cached_attention
+    import jax.numpy as jnp
 
     b = int(shape.get("b", 8))
     L, h, d = (int(shape["max_len"]), int(shape["heads"]),
                int(shape["d"]))
+    ps = int(shape.get("page_size", 0))
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (b, h, d), dtype) * 0.2
-    kc = jax.random.normal(ks[1], (b, L, h, d), dtype) * 0.2
-    vc = jax.random.normal(ks[2], (b, L, h, d), dtype) * 0.2
-    import jax.numpy as jnp
-
     positions = jnp.full((b,), L - 1, jnp.int32)  # worst case: full cache
     bk = params["block_k"]
+
+    if ps:
+        # paged layout: time the page-table gather path at full residency
+        # (every slot's table maps distinct live pages, like a busy pool)
+        from apex_tpu.serve.attention import paged_attention
+
+        mp = L // ps
+        P = b * mp + 1                         # +1: the reserved null page
+        kc = jax.random.normal(ks[1], (P, ps, h, d), dtype) * 0.2
+        vc = jax.random.normal(ks[2], (P, ps, h, d), dtype) * 0.2
+        table = jnp.arange(1, P, dtype=jnp.int32).reshape(b, mp)
+
+        def step(i, q, kc, vc):
+            return paged_attention(q, kc, vc, table, positions,
+                                   block_k=bk, interpret=interpret)
+
+        return step, q, (kc, vc)
+
+    from apex_tpu.serve.attention import cached_attention
+
+    kc = jax.random.normal(ks[1], (b, L, h, d), dtype) * 0.2
+    vc = jax.random.normal(ks[2], (b, L, h, d), dtype) * 0.2
 
     def step(i, q, kc, vc):
         return cached_attention(q, kc, vc, positions, block_k=bk,
@@ -545,7 +575,11 @@ _register(KernelSpec(
 _register(KernelSpec(
     "decode_attention", _da_shape_key, _da_defaults, _da_candidates,
     _da_build,
-    default_shapes=({"b": 8, "max_len": 2048, "heads": 16, "d": 64},)))
+    # both layouts warm by default: the slot cache and the paged pool at
+    # the serving default page size (page_size=0 means slot layout)
+    default_shapes=({"b": 8, "max_len": 2048, "heads": 16, "d": 64},
+                    {"b": 8, "max_len": 2048, "page_size": 256,
+                     "heads": 16, "d": 64})))
 _register(KernelSpec(
     "fused_adam", _flat_shape_key, _flat_defaults, _flat_candidates,
     _adam_build, default_shapes=({"numel": 134_217_728},),

@@ -26,6 +26,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -498,6 +499,420 @@ def test_decode_attention_registered_with_tune():
     assert out.shape == state.shape
 
 
+# ----------------------------------- paged KV pool + prefix caching
+
+@pytest.fixture(scope="module")
+def paged3(params):
+    """Shared 3-slot paged greedy engine (page_size 8, slot-equivalent
+    pool, prefix index on); tests reset() it — compiled once. The prefix
+    index only fires on page-aligned shared prompts, so parity tests
+    with distinct prompts ride the plain paged path."""
+    return _engine(params, page_size=8, prefix_cache=True)
+
+
+@pytest.fixture(scope="module")
+def slot8(params):
+    """Slot-cache greedy oracle pinned to block_k=8 — the paged
+    engine's chunk geometry. Bit-exactness across layouts holds at
+    EQUAL block_k (only the K/V fetch differs then); at different
+    block_k the softmax partial-sum order differs by design, exactly
+    like two block_k values on the same layout."""
+    return _engine(params, block_k=8)
+
+
+def _mixed_requests(n=5, seed0=0, max_new=5):
+    """Mixed-length prompts on 3 slots: staggered completions force
+    eviction + backfill mid-trace."""
+    return [Request(request_id=f"r{i}",
+                    tokens=_tokens(4 + 3 * (i % 4), seed=seed0 + i),
+                    max_new_tokens=max_new) for i in range(n)]
+
+
+def _trace_outputs(eng, reqs, injector=None):
+    sched = ServeScheduler(eng, fault_injector=injector)
+    for r in reqs:
+        sched.submit(r)
+    return {r["request_id"]: r for r in sched.run().requests}
+
+
+def test_paged_bit_exact_vs_slot_greedy(slot8, paged3):
+    """THE paged acceptance: an identical mixed-length request trace
+    through the slot engine (the oracle) and the paged engine produces
+    bit-identical greedy streams — the chunked-softmax arithmetic is
+    shared verbatim, only the K/V fetch differs. Both engines run the
+    same block_k (paged3's page-sized default): equal chunk geometry is
+    the bit-exactness precondition, and the autotuner keys it per
+    layout so a deployment pins it the same way."""
+    assert paged3.block_k == slot8.block_k == 8
+    base = _trace_outputs(slot8.reset(), _mixed_requests())
+    got = _trace_outputs(paged3.reset(), _mixed_requests())
+    assert {k: v["generated"] for k, v in got.items()} == \
+           {k: v["generated"] for k, v in base.items()}
+    assert {k: v["finish_reason"] for k, v in got.items()} == \
+           {k: v["finish_reason"] for k, v in base.items()}
+
+
+def test_paged_decode_logits_bit_exact_vs_slot_prefill(params, paged3):
+    """Strongest oracle form: a PAGED engine's incremental decode logits
+    equal the SLOT engine's full-sequence prefill logits bit-for-bit in
+    fp32 — crossing both the layout and the prefill/decode path (at the
+    shared block_k=8 chunk geometry)."""
+    seq = _tokens(12)
+    keeper = _engine(params, keep_prefill_logits=True, block_k=8)
+    _, _, all_logits = keeper.prefill({1: seq})
+    all_logits = np.asarray(all_logits)          # [P, B, V]
+    inc = paged3.reset()
+    inc.prefill({1: seq[:5]})
+    for j in range(5, len(seq)):
+        forced = np.array([0, seq[j], 0], np.int32)
+        _, logits = inc.decode_step(forced, np.array([False, True, False]))
+        a, b = all_logits[j, 1], np.asarray(logits)[1]
+        assert a.dtype == np.float32
+        assert np.array_equal(a, b), \
+            f"paged decode pos {j} drifted: max|d|={np.abs(a - b).max()}"
+
+
+def test_paged_bit_exact_vs_slot_sampled(params):
+    """Seeded sampling: the PRNG key is engine state split once per
+    prefill/decode call in BOTH layouts, so identical traces consume
+    identical key paths — sampled streams match token-for-token."""
+    kw = dict(temperature=0.8, top_k=5, block_k=8)
+    base = _trace_outputs(_engine(params, **kw), _mixed_requests(max_new=6))
+    got = _trace_outputs(_engine(params, page_size=8, **kw),
+                         _mixed_requests(max_new=6))
+    assert {k: v["generated"] for k, v in got.items()} == \
+           {k: v["generated"] for k, v in base.items()}
+
+
+def test_paged_decode_compiles_once_across_admit_evict_backfill(params):
+    """The one-compile invariant survives paging: page tables are data,
+    so admissions, completions, a scripted mid-stream abort, and
+    backfill (page alloc/release/COW churn included) trace decode_step
+    exactly once. Fresh engine: the counters are the assertion."""
+    eng = _engine(params, num_slots=2, page_size=8, prefix_cache=True)
+    inj = FaultInjector(seed=0).abort_request("r2", at_step=4)
+    sched = ServeScheduler(eng, fault_injector=inj)
+    for i, plen in enumerate((4, 6, 5, 3, 7)):
+        sched.submit(Request(request_id=f"r{i}",
+                             tokens=_tokens(plen, seed=i),
+                             max_new_tokens=4 + i % 3))
+    stats = sched.run()
+    assert len(stats.requests) == 5
+    assert {r["state"] for r in stats.requests} == {"completed", "evicted"}
+    assert eng.decode_traces == 1, \
+        "page-table churn must not retrace decode_step"
+    assert eng.prefill_traces <= 2          # pow2 buckets {4, 8}
+
+
+def test_prefix_hit_skips_prefill(paged3):
+    """A request whose prompt prefix is resident skips prefill for those
+    pages: asserted by the engine's scan counters (never wall clock), the
+    serve_prefix_hit event fires, and the stream built on shared pages is
+    bit-identical to a cold prefill."""
+    eng = paged3.reset()
+    sysp = _tokens(16, seed=42)              # two full pages
+    warm_prompt = sysp + _tokens(5, seed=2)
+    # cold baseline for the WARM request (fresh engine state, no index)
+    base = _trace_outputs(eng, [Request(request_id="b",
+                                        tokens=list(warm_prompt),
+                                        max_new_tokens=3)])
+    eng.reset()
+    # cold run seeds the index (a request can't hit its own admission)
+    _trace_outputs(eng, [Request(request_id="a",
+                                 tokens=sysp + _tokens(5, seed=1),
+                                 max_new_tokens=3)])
+    assert eng.prefix_hits == 0
+    scanned_cold = eng.prefill_scanned_tokens
+    seen = []
+    unsub = subscribe_events(
+        lambda r: seen.append(r)
+        if r.get("event") == "serve_prefix_hit" else None)
+    try:
+        got = _trace_outputs(eng, [Request(request_id="b",
+                                           tokens=list(warm_prompt),
+                                           max_new_tokens=3)])
+    finally:
+        unsub()
+    assert eng.prefix_hits == 1 and eng.prefix_hit_tokens == 16
+    # the warm prefill scanned only the 5-token tail's pow2 bucket (8),
+    # not the 21-token prompt's (32): the prefill-work skip, in counters
+    assert eng.prefill_scanned_tokens - scanned_cold == 8
+    assert len(seen) == 1
+    assert seen[0]["hit_tokens"] == 16 and seen[0]["hit_pages"] == 2
+    assert seen[0]["scanned_tokens"] == 5
+    # shared read-only pages hold the same bytes a cold prefill writes
+    assert got["b"]["generated"] == base["b"]["generated"]
+
+
+def test_prefix_cache_cow_tail_page(paged3):
+    """A fully-cached prompt caps its hit one token short (the final
+    prompt token must re-run to seed sampling), which copies the
+    boundary page (copy-on-write) before appending — and stays
+    bit-exact."""
+    eng = paged3.reset()
+    sysp = _tokens(16, seed=42)              # exactly two pages
+    cold = _trace_outputs(eng, [Request(request_id="cold",
+                                        tokens=list(sysp),
+                                        max_new_tokens=4)])
+    warm = _trace_outputs(eng, [Request(request_id="warm",
+                                        tokens=list(sysp),
+                                        max_new_tokens=4)])
+    # 1 full page shared + COW of the second: 15 of 16 tokens reused
+    assert eng.prefix_hits == 1 and eng.prefix_hit_tokens == 15
+    assert warm["warm"]["generated"] == cold["cold"]["generated"]
+    # the index's read-only page survived the COW append untouched: a
+    # third identical prompt hits the same 15 tokens again
+    warm2 = _trace_outputs(eng, [Request(request_id="w2",
+                                         tokens=list(sysp),
+                                         max_new_tokens=4)])
+    assert eng.prefix_hit_tokens == 30
+    assert warm2["w2"]["generated"] == cold["cold"]["generated"]
+
+
+def test_engine_reset_clears_pool_and_prefix_index(paged3):
+    """Satellite regression: reset() must return every page to the free
+    list and drop the prefix index — tests share compiled engines across
+    scenarios, and a leaked refcount would poison the next one."""
+    eng = paged3.reset()
+    sysp = _tokens(16, seed=42)
+    first = _trace_outputs(eng, [
+        Request(request_id=f"r{i}", tokens=sysp + _tokens(3, seed=i),
+                max_new_tokens=3) for i in range(2)])
+    # completed requests released their pages; the index still pins the
+    # shared prefix pages — exactly what reset() must reclaim
+    assert len(eng.prefix) == 2
+    assert eng.pool.free_count < eng.pool.capacity
+    eng.reset()
+    assert eng.pool.free_count == eng.pool.capacity
+    assert all(rc == 0 for rc in eng.pool.refcount[1:])
+    assert len(eng.prefix) == 0
+    assert eng.prefix_hits == 0 and eng.prefill_calls == 0
+    assert np.asarray(eng.cache.lengths).max() == 0
+    # the scenario replays bit-identically on the reset engine
+    again = _trace_outputs(eng, [
+        Request(request_id=f"r{i}", tokens=sysp + _tokens(3, seed=i),
+                max_new_tokens=3) for i in range(2)])
+    assert {k: v["generated"] for k, v in again.items()} == \
+           {k: v["generated"] for k, v in first.items()}
+
+
+def test_paged_geometry_validation(params):
+    """Bad pool geometry is a clear build-time ValueError, never a bad
+    gather at trace time."""
+    with pytest.raises(ValueError, match="divide"):
+        _engine(params, page_size=5)              # 32 % 5 != 0
+    with pytest.raises(ValueError, match="divide page_size"):
+        _engine(params, page_size=8, block_k=16)  # chunk spans 2 pages
+    with pytest.raises(ValueError, match="null page"):
+        _engine(params, page_size=8, num_pages=4)  # < max_pages + 1
+    with pytest.raises(ValueError, match="prefix_cache"):
+        _engine(params, prefix_cache=True)        # needs the pool
+    with pytest.raises(ValueError, match="num_pages"):
+        _engine(params, num_pages=9)              # needs page_size
+
+
+def test_overcommitted_pool_stalls_then_completes(slot8, params):
+    """An overcommitted pool (the point of paging) admits what fits and
+    stalls the queue head until completions free pages — the stall is
+    charged to serve_page_alloc_fail (a timed cause distinct from
+    queue_wait), every request completes, and outputs still match the
+    slot oracle bit-for-bit."""
+    base = _trace_outputs(slot8.reset(), _mixed_requests())
+    # 5 allocatable pages against ~2-page reservations: two requests fit,
+    # the third stalls on pages while a SLOT sits free — KV-bound, not
+    # slot-bound (admission order shifts, per-slot greedy streams don't)
+    eng = _engine(params, page_size=8, num_pages=6)
+    stalls = []
+    unsub = subscribe_events(
+        lambda r: stalls.append(r)
+        if r.get("event") == "serve_page_alloc_fail" else None)
+    try:
+        with GoodputLedger() as led:
+            got = _trace_outputs(eng, _mixed_requests())
+    finally:
+        unsub()
+    assert {k: v["generated"] for k, v in got.items()} == \
+           {k: v["generated"] for k, v in base.items()}
+    s = led.summary()
+    assert s["events"].get("serve_page_alloc_fail", 0) >= 1
+    assert s["lost_by_cause"].get("serve_page_alloc_fail", 0.0) > 0.0
+    # every published stall is a REAL cross-tick window — an admission
+    # that merely rides along while the head stays blocked must not
+    # close-and-reopen the window as a spurious ~0s event
+    assert all(e["seconds"] > 1e-4 for e in stalls), stalls
+    assert eng.decode_traces == 1
+
+
+def test_idle_tick_releases_pages_for_stalled_admission(params):
+    """Review regression: when every running request terminates without
+    a decode step following (an abort on an otherwise-idle tick), the
+    deferred device-side eviction must still run — before the fix a
+    paged engine's pages stayed refcounted, the queue head's page probe
+    failed forever, and the scheduler livelocked with a free slot, a
+    non-empty queue, and decode_steps pinned below max_steps."""
+    eng = _engine(params, num_slots=2, page_size=8, num_pages=6)
+    sched = ServeScheduler(eng)
+    # the hog reserves 4 of the 5 allocatable pages
+    sched.submit(Request(request_id="hog", tokens=_tokens(10),
+                         max_new_tokens=20))
+    sched.step()
+    sched.submit(Request(request_id="r1", tokens=_tokens(9, seed=3),
+                         max_new_tokens=4))
+    assert sched.abort("hog") is True
+    # bounded manual ticks (never run(): the pre-fix failure mode is an
+    # unbounded loop) — r1 must get the hog's pages and complete
+    for _ in range(40):
+        if not sched.step():
+            break
+    recs = {r["request_id"]: r for r in sched.stats().requests}
+    assert recs["r1"]["state"] == "completed", recs
+    assert recs["hog"]["state"] == "evicted"
+
+
+def test_admission_probe_protects_batch_hits(params):
+    """Review hardening: the admission probe threads a protect set
+    across a batch, so a page one member plans to share is never counted
+    as evictable headroom for a later member — otherwise prefill (which
+    protects the whole batch's hits from eviction) would free fewer
+    pages than the probes assumed and fail allocation mid-batch."""
+    eng = _engine(params, num_slots=3, max_len=16, page_size=8,
+                  num_pages=5, prefix_cache=True)
+    p1, p2 = _tokens(8, seed=21), _tokens(8, seed=22)
+    _trace_outputs(eng, [
+        Request(request_id="s1", tokens=p1 + [1], max_new_tokens=1),
+        Request(request_id="s2", tokens=p2 + [2], max_new_tokens=1)])
+    assert len(eng.prefix) == 2 and eng.pool.free_count == 2
+    # hold the remaining free pages in a live slot: every further page
+    # must now come from evicting an index entry
+    eng.prefill({0: _tokens(9, seed=30)}, budgets={0: 1})
+    assert eng.pool.free_count == 0
+    protect: set = set()
+    # member 1 hits p1's page and takes the last evictable (p2's) as
+    # its fresh page
+    c1 = eng.admission_page_cost(p1 + [5, 6], 1, 0, protect=protect)
+    assert c1 == 1 and protect
+    # member 2 needs one page; p1's page must NOT count as its headroom
+    # (member 1 is sharing it) — before the fix this probe passed and
+    # prefill raised PagePoolExhausted mid-batch
+    assert eng.admission_page_cost(_tokens(6, seed=31), 1, c1,
+                                   protect=protect) is None
+
+
+def test_stall_window_closes_when_stalled_head_leaves_queue(params):
+    """Review regression: a queue head stalled on pages that then leaves
+    the queue WITHOUT being admitted (abort here; deadline expiry and
+    load shedding share ``_stall_head_removed``) must close-and-charge
+    the stall window at its departure — before the fix the window stayed
+    open and the NEXT admission charged the whole intervening idle span
+    to ``serve_page_alloc_fail`` as phantom lost capacity."""
+    eng = _engine(params, num_slots=2, page_size=8, num_pages=6)
+    sched = ServeScheduler(eng)
+    stalls = []
+    unsub = subscribe_events(
+        lambda r: stalls.append(r)
+        if r.get("event") == "serve_page_alloc_fail" else None)
+    try:
+        # the hog reserves 4 of the 5 allocatable pages; "big" needs 2
+        # pages and stalls at the head
+        sched.submit(Request(request_id="hog", tokens=_tokens(10),
+                             max_new_tokens=20))
+        sched.step()
+        sched.submit(Request(request_id="big", tokens=_tokens(9, seed=3),
+                             max_new_tokens=4))
+        sched.step()
+        assert sched._alloc_stall_t0 is not None   # window open
+        time.sleep(0.03)                           # real blocked span
+        assert sched.abort("big") is True
+        # closed AT removal: the blocked span is charged, nothing after
+        assert sched._alloc_stall_t0 is None
+        assert len(stalls) == 1 and stalls[0]["seconds"] >= 0.03
+        time.sleep(0.2)                            # idle, pool unchanged
+        # "late" fits the remaining free page and admits instantly: no
+        # second stall event, and in particular none spanning the idle
+        sched.submit(Request(request_id="late", tokens=_tokens(3, seed=4),
+                             max_new_tokens=2))
+        for _ in range(60):
+            if not sched.step():
+                break
+    finally:
+        unsub()
+    recs = {r["request_id"]: r for r in sched.stats().requests}
+    assert recs["late"]["state"] == "completed", recs
+    assert len(stalls) == 1, stalls
+    assert eng.decode_traces == 1
+
+
+def test_combine_chunks_fetches_each_chunk_once():
+    """Review perf regression: ``_combine_chunks`` materializes each
+    chunk's (K, V) exactly once — a second ``fetch(i)`` per chunk traced
+    four page-table gathers where two suffice (and actually executed
+    them under interpret=True)."""
+    from apex_tpu.serve.attention import _combine_chunks, cached_attention
+
+    rng = np.random.RandomState(0)
+    k = rng.randn(2, 16, 2, 4).astype(np.float32)
+    v = rng.randn(2, 16, 2, 4).astype(np.float32)
+    q = jnp.asarray(rng.randn(2, 2, 4).astype(np.float32))
+    pos = jnp.asarray([5, 9], dtype=jnp.int32)
+    calls = []
+
+    def fetch(i):
+        calls.append(i)
+        sl = slice(i * 4, (i + 1) * 4)
+        return jnp.asarray(k[:, sl]), jnp.asarray(v[:, sl])
+
+    out = _combine_chunks(q, pos, 16, 4, jnp.float32(0.5), fetch)
+    assert sorted(calls) == [0, 1, 2, 3], calls    # once per chunk
+    # and the single-fetch path is the SAME numbers the public slot
+    # entry point produces at the same block_k
+    ref = cached_attention(q, jnp.asarray(k), jnp.asarray(v), pos,
+                           scale=0.5, block_k=4)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_plan_admission_empty_prompt():
+    """Review regression: an empty prompt (legal on the slot path — only
+    ``ServeScheduler.submit`` rejects it) must plan zero shared tokens
+    instead of ``use=-1`` whose tail-page remainder indexed ``hits[-1]``
+    on an empty hit list."""
+    from apex_tpu.serve import paging
+
+    for idx in (None, paging.PrefixIndex(page_size=8)):
+        plan = paging.plan_admission([], 4, 32, 8, idx)
+        assert plan["use"] == 0 and plan["shared_pages"] == 0
+        assert plan["cow_src"] is None and plan["tail"] == []
+        assert plan["hits"] == []
+        assert plan["new_pages"] == plan["total_pages"] >= 1
+
+
+def test_decode_attention_page_geometry_registered():
+    """Satellite: page_size is a shape-key axis of the decode_attention
+    autotuner (slot=0 and paged winners never collide), candidates must
+    divide the page, and CODE_VERSIONS invalidates v1 slot-only
+    entries."""
+    from apex_tpu.tune import CODE_VERSIONS
+    from apex_tpu.tune import registry
+
+    assert CODE_VERSIONS["decode_attention"] >= 2
+    spec = registry.spec("decode_attention")
+    k_slot = spec.shape_key({"max_len": 64, "heads": 2, "d": 8})
+    k_paged = spec.shape_key({"max_len": 64, "page_size": 16,
+                              "heads": 2, "d": 8})
+    assert k_slot != k_paged
+    assert ("page_size", 0) in k_slot and ("page_size", 16) in k_paged
+    paged_shape = {"b": 2, "max_len": 64, "page_size": 16,
+                   "heads": 2, "d": 8}
+    cands = spec.candidates(paged_shape)
+    assert cands and all(16 % c["block_k"] == 0 for c in cands)
+    assert spec.defaults(paged_shape) in cands
+    # the registry's default shapes warm BOTH layouts
+    assert any(s.get("page_size") for s in spec.default_shapes)
+    # the paged build runs the real page-table gather path
+    p = spec.defaults(paged_shape)
+    step, q, consts = spec.build(paged_shape, jnp.float32, p)
+    assert step(0, q, *consts).shape == q.shape
+
+
 # ------------------------------------------------------------ CLIs
 
 def _cli_env():
@@ -634,6 +1049,112 @@ def test_bench_serve_smoke_and_regression_gate(tmp_path, capsys):
     shedding["serve_decode"]["rejected"] = 5
     shedding["serve_decode"]["shed_rate"] = 0.31
     path_cur.write_text(json.dumps(shedding))
+    assert check_regression.main([str(path_cur), "--suite",
+                                  str(path_base),
+                                  "--kernels", "serve_decode"]) == 1
+
+
+def test_serve_cli_paged_smoke(capsys, monkeypatch):
+    """``apex-tpu-serve --page-size --prefix-cache``: bad geometry is a
+    clean usage error; a shared-prefix stdin stream serves with one
+    decode compile and a real prefix hit. In-process (the subprocess
+    smoke above covers the entry point)."""
+    import io
+
+    from apex_tpu.serve import cli
+
+    # pool geometry that can't exist: exit 2 + the engine's message
+    assert cli.main(["--config", "tiny", "--max-len", "32",
+                     "--page-size", "7", "--requests", "1"]) == 2
+    assert "divide" in capsys.readouterr().err
+    # --prefix-cache without --page-size: same clean refusal
+    assert cli.main(["--config", "tiny", "--max-len", "32",
+                     "--prefix-cache", "--requests", "1"]) == 2
+    assert "prefix_cache" in capsys.readouterr().err
+
+    # one slot serializes the two requests, so the second admission sees
+    # the first's prompt pages resident: a real end-to-end prefix hit
+    prefix = " ".join(str(t) for t in range(1, 9))     # one full page
+    monkeypatch.setattr("sys.stdin", io.StringIO(
+        f"{prefix} 11\n{prefix} 12\n"))
+    rc = cli.main(["--config", "tiny", "--stdin", "--max-len", "32",
+                   "--num-slots", "1", "--max-new-tokens", "2",
+                   "--temperature", "0", "--page-size", "8",
+                   "--prefix-cache"])
+    assert rc == 0
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    recs, summary = lines[:-1], lines[-1]
+    assert all(rec["state"] == "completed" for rec in recs)
+    assert summary["decode_compiles"] == 1
+    assert summary["summary"]["prefix_hits"] == 1
+    assert summary["summary"]["prefix_hit_rate"] == 0.5
+    assert summary["summary"]["peak_resident_tokens"] > 0
+
+
+def test_serve_bench_usage_errors_exit_clean():
+    """Review regression: bad pool geometry and a malformed
+    ``--prompt-len`` spec are usage errors — one clean message via
+    SystemExit (like the adjacent shared-prefix check), never a raw
+    ValueError traceback."""
+    from apex_tpu.bench_cli import _serve_bench
+
+    with pytest.raises(SystemExit, match="page_size=7 must"):
+        _serve_bench(steps=2, max_len=32, page_size=7)
+    with pytest.raises(SystemExit, match="--prompt-len"):
+        _serve_bench(steps=2, prompt_len="0:4")
+
+
+def test_paged_bench_capacity_and_gate(tmp_path, capsys):
+    """ISSUE 9 bench acceptance, at tier-1 scale: on a mixed-length
+    shared-prefix workload, the paged capture shows >= 2x resident
+    tokens per HBM byte vs the slot capture at the same workload,
+    prefix_hit_rate > 0, and the capture gates through check_regression
+    with page_size provenance (a lower hit rate regresses)."""
+    from apex_tpu.bench_cli import _serve_bench
+
+    # mixed 8..24-token prompts + a 16-token fleet-wide system prefix on
+    # a max_len=128 context: the slot layout reserves 128 tokens/slot
+    # for ~48-token requests — the waste paging reclaims
+    kw = dict(steps=16, num_slots=4, max_len=128, prompt_len="8:24",
+              shared_prefix=16)
+    _serve_bench(**kw)
+    slot = json.loads(capsys.readouterr().out)["serve_decode"]
+    # equal workload, pool sized to the actual working set: 4 slots x 4
+    # own pages + 2 shared prefix pages + the null page
+    _serve_bench(**kw, page_size=8, num_pages=19, prefix_cache=True)
+    suite = json.loads(capsys.readouterr().out)
+    paged = suite["serve_decode"]
+
+    assert paged["prefix_hit_rate"] > 0.0
+    assert slot["prefix_hit_rate"] == 0.0
+    assert paged["resident_tokens_per_hbm_byte"] >= \
+        2.0 * slot["resident_tokens_per_hbm_byte"], \
+        "paging must multiply resident-token capacity per HBM byte"
+    # provenance: the pool geometry rides the workload record, so SLO/
+    # capacity numbers are never gated across incomparable configs
+    assert paged["workload"]["page_size"] == 8
+    assert paged["workload"]["prefix_cache"] is True
+    assert paged["workload"]["shared_prefix"] == 16
+    assert slot["workload"]["page_size"] == 0
+
+    path_cur = tmp_path / "cur.json"
+    path_base = tmp_path / "base.json"
+    path_base.write_text(json.dumps(suite))
+    path_cur.write_text(json.dumps(suite))
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_regression
+    finally:
+        sys.path.pop(0)
+    assert check_regression.main([str(path_cur), "--suite",
+                                  str(path_base),
+                                  "--kernels", "serve_decode"]) == 0
+    # prefix_hit_rate is higher-is-better: losing the hits regresses
+    worse = json.loads(json.dumps(suite))
+    worse["serve_decode"]["prefix_hit_rate"] = \
+        paged["prefix_hit_rate"] * 0.2
+    path_cur.write_text(json.dumps(worse))
     assert check_regression.main([str(path_cur), "--suite",
                                   str(path_base),
                                   "--kernels", "serve_decode"]) == 1

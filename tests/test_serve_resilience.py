@@ -253,6 +253,54 @@ def test_hbm_pressure_counts_as_overload():
     assert adm.clamp(16) == 16
 
 
+def test_pool_low_watermark_counts_as_overload(paged2):
+    """PR-9 satellite: a drained paged-KV free list is an overload
+    signal like queue depth and HBM pressure — and the scheduler feeds
+    ``Engine.free_page_frac`` to the controller each tick, so sustained
+    pool pressure clamps admitted budgets end-to-end."""
+    adm = AdmissionController(degraded_max_new_tokens=2, sustain_ticks=1,
+                              pool_frac_low=0.10)
+    assert not adm.overloaded(queue_depth=0)
+    adm.note_pool(0.05)                    # below the low watermark
+    assert adm.overloaded(queue_depth=0)
+    assert adm.on_tick(0) is True and adm.degraded
+    assert adm.clamp(16) == 2
+    adm.note_pool(0.8)
+    assert adm.on_tick(0) is False and not adm.degraded
+    adm.note_pool(None)                    # no signal: state unchanged
+    assert not adm.overloaded(queue_depth=0)
+
+    # end-to-end: a drained overcommitted pool degrades admitted budgets.
+    # r0 finishes fast and frees its slot while long-running r1 keeps
+    # holding pages, so r2 is admitted INTO the drained-pool window and
+    # gets the clamp
+    eng = paged2.reset()
+    sysp = _tokens(8, seed=99)
+    adm = AdmissionController(degraded_max_new_tokens=3, sustain_ticks=1,
+                              pool_frac_low=0.60)
+    sched = ServeScheduler(eng, admission=adm)
+    seen = []
+    unsub = subscribe_events(
+        lambda r: seen.append(r)
+        if r.get("event") == "serve_degraded_mode" else None)
+    try:
+        for rid, tail, max_new in (("r0", 3, 2), ("r1", 4, 8),
+                                   ("r2", 5, 8)):
+            sched.submit(Request(request_id=rid,
+                                 tokens=sysp + _tokens(tail, seed=ord(
+                                     rid[-1])),
+                                 max_new_tokens=max_new))
+        stats = sched.run()
+    finally:
+        unsub()
+    assert any(e["entered"] for e in seen)
+    recs = {r["request_id"]: r for r in stats.requests}
+    assert all(r["state"] == "completed" for r in recs.values())
+    assert recs["r1"]["new_tokens"] == 8      # pre-overload budget kept
+    assert recs["r2"]["new_tokens"] == 3, \
+        "the degraded-window admission should have been clamped to 3"
+
+
 # ------------------------------------------------ warm restart / chaos
 
 def _run_supervised(eng, injector, requests, *, max_restarts=2,
@@ -513,6 +561,120 @@ def test_serve_cli_resilience_flags(capsys):
     assert s["rejected"] == 2 and s["shed_rate"] == pytest.approx(0.5)
     assert s["deadline_exceeded"] == 0 and s["restarts"] == 0
     assert summary["decode_compiles"] == 1
+
+
+# ------------------------------------------ warm restart under paging
+
+@pytest.fixture(scope="module")
+def paged2(params):
+    """Shared 2-slot paged+prefix greedy engine for the paging
+    resilience tests; reset() keeps the compile."""
+    return Engine(CFG, params,
+                  EngineConfig(num_slots=2, max_len=32, temperature=0.0,
+                               page_size=8, prefix_cache=True), seed=0)
+
+
+def _prefix_requests(n=4, max_new=6):
+    """Mixed requests sharing one full-page system prefix, so shared
+    read-only pages are resident (and index-pinned) at crash time."""
+    sysp = _tokens(8, seed=99)
+    return [Request(request_id=f"r{i}",
+                    tokens=sysp + _tokens(3 + i % 3, seed=i),
+                    max_new_tokens=max_new) for i in range(n)]
+
+
+def test_chaos_smoke_under_paging(paged2):
+    """ISSUE 9 acceptance: THE PR-8 chaos smoke re-run on a paged engine
+    with shared prefix pages — decode-step crash + latency spike + queue
+    storm. Every submitted request reaches exactly one terminal status,
+    surviving greedy outputs are bit-identical to the uncrashed paged
+    run, and decode_traces delta is 0 across the recovery."""
+    base_sched = ServeScheduler(paged2.reset())
+    for r in _prefix_requests(4):
+        base_sched.submit(r)
+    base = {r["request_id"]: r["generated"]
+            for r in base_sched.run().requests}
+    traces_before = paged2.decode_traces
+
+    inj = (FaultInjector(seed=0)
+           .crash_on_decode_step(2)
+           .latency_spike(4, 0.02)
+           .queue_storm(3, 3, prompt_len=4, max_new_tokens=2))
+    sched, stats = _run_supervised(paged2.reset(), inj,
+                                   _prefix_requests(4))
+    assert paged2.decode_traces == traces_before, \
+        "paged recover() must reuse the compiled decode executable"
+    assert stats.restarts == 1
+    _assert_exactly_one_terminal(
+        sched, [f"r{i}" for i in range(4)] + [f"storm-{i}"
+                                              for i in range(3)])
+    recs = {r["request_id"]: r for r in stats.requests}
+    for rid, gen in base.items():
+        assert recs[rid]["state"] == "completed"
+        assert recs[rid]["generated"] == gen, \
+            f"{rid} drifted across the paged warm restart"
+
+
+def test_warm_restart_paged_determinism_and_journal(paged2):
+    """Crash at every early tick in turn: the paged engine's greedy
+    outputs always equal the uncrashed run (recovery re-prefill through
+    shared pages is bit-exact), and the journal payload records the page
+    accounting — tables, refcounts, prefix-index size — for the
+    postmortem."""
+    base_sched = ServeScheduler(paged2.reset())
+    for r in _prefix_requests(3):
+        base_sched.submit(r)
+    base = {r["request_id"]: r["generated"]
+            for r in base_sched.run().requests}
+    journal = None
+    for crash_at in (0, 1, 4):
+        journal = TickJournal()
+        inj = FaultInjector(seed=0).crash_on_decode_step(crash_at)
+        sched, stats = _run_supervised(paged2.reset(), inj,
+                                       _prefix_requests(3),
+                                       journal=journal)
+        assert stats.restarts == 1, crash_at
+        got = {r["request_id"]: r["generated"] for r in stats.requests}
+        assert got == base, \
+            f"paged crash at step {crash_at} changed outputs"
+    payload = journal.to_payload()
+    pg = payload["paging"]
+    assert pg["page_size"] == 8
+    assert len(pg["refcounts"]) == pg["num_pages"]
+    assert len(pg["page_table"]) == 2           # [num_slots][max_pages]
+    assert all(len(row) == 4 for row in pg["page_table"])
+
+
+def test_slot_journal_document_unchanged(greedy2):
+    """Pre-paging journal consumers see an unchanged document: a slot
+    engine's payload carries no 'paging' key at all."""
+    sched = ServeScheduler(greedy2.reset(), journal=TickJournal())
+    for r in _requests(2, max_new=2):
+        sched.submit(r)
+    sched.run()
+    assert "paging" not in sched.journal.to_payload()
+
+
+def test_paged_recovery_reprefills_only_unshared_pages(paged2):
+    """recover() keeps the pool bytes and the prefix index (shared pages
+    are read-only — the crash cannot have torn them): each surviving
+    slot's recovery re-prefill HITS the index for its prompt pages and
+    scans only the generated tail — proven by the hit counters, and only
+    the original prompt ever enters the index (generated-token pages
+    must not pin it)."""
+    inj = FaultInjector(seed=0).crash_on_decode_step(3)
+    sched, stats = _run_supervised(paged2.reset(), inj,
+                                   _prefix_requests(2, max_new=8))
+    assert stats.restarts == 1
+    recs = {r["request_id"]: r for r in stats.requests}
+    assert all(r["state"] == "completed" for r in recs.values())
+    # the cold admission batch can't hit (inserts land post-batch), so
+    # both hits are the recovery re-prefills riding the surviving index
+    assert paged2.prefix_hits == 2
+    assert paged2.prefix_hit_tokens == 16       # one 8-token page each
+    # index holds ONLY prompt-page hashes: prompts are 11/12 tokens ->
+    # one full page each, deduped to the single shared sysp chunk
+    assert len(paged2.prefix) == 1
 
 
 # ------------------------------------------------------- the slow sweep

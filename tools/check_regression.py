@@ -70,9 +70,12 @@ _LOWER_SUFFIXES = ("_ms", "_s", "_latency")
 _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 "shed_rate", "rejected", "deadline_exceeded")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
-# ends in "_s" but is a rate)
+# ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
+# beat the "_rate" lower-hint family: fewer hits means more repeated
+# prefill, which is strictly worse.
 _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
-                 "vs_baseline", "goodput", "imgs", "tokens", "seqs")
+                 "vs_baseline", "goodput", "imgs", "tokens", "seqs",
+                 "hit_rate")
 
 
 def lower_is_better(name: str, unit: Optional[str] = None) -> bool:
