@@ -267,7 +267,10 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  shared_prefix: int = 0,
                  page_size: "int | None" = None,
                  num_pages: "int | None" = None,
-                 prefix_cache: bool = False) -> None:
+                 prefix_cache: bool = False,
+                 metrics_port: "int | None" = None,
+                 metrics_snapshot: "str | None" = None,
+                 tenants: int = 0) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -287,6 +290,13 @@ def _serve_bench(steps: int, num_slots: int = 4,
     from shared prefix pages) land in the entry, higher-is-better, and
     every pool/workload knob rides the nested ``workload`` provenance so
     the gate never compares incomparable configs (PR-8 precedent).
+
+    ``--metrics-port`` serves live Prometheus/JSON scrapes while the
+    bench runs and ``--metrics-snapshot`` commits the mergeable
+    per-rank snapshot at exit (``tools/metrics_merge.py`` folds these,
+    and ``check_regression`` gates them directly — the live scrape and
+    this bench produce comparably gateable artifacts); ``--tenants N``
+    labels the scripted workload round-robin for a per-tenant view.
     """
     import dataclasses
     import json
@@ -309,6 +319,41 @@ def _serve_bench(steps: int, num_slots: int = 4,
         plo, phi = _parse_prompt_lens(prompt_len)
     except ValueError as e:
         raise SystemExit(f"apex-tpu-bench: {e}")
+    # live metrics: same wiring as apex-tpu-serve — registry + optional
+    # pull endpoint on a daemon thread, atomic snapshot at exit; the
+    # scrape-vs-bench comparability is the point (check_regression gates
+    # either artifact with the same direction hints). Armed BEFORE the
+    # engine pays for params + compiles: an inert --tenants or an
+    # unbindable port must fail in milliseconds, not after trace time
+    metrics = exporter = None
+    if tenants > 0 and metrics_port is None and not metrics_snapshot:
+        # the labels would reach no observable output — the armed-but-
+        # inert flag class this PR makes a loud usage error everywhere
+        raise SystemExit(
+            "apex-tpu-bench: --tenants labels the live metrics; it "
+            "needs --metrics-port and/or --metrics-snapshot to be "
+            "observable")
+    if metrics_port is not None or metrics_snapshot:
+        from apex_tpu.monitor.export import MetricsExporter
+        from apex_tpu.serve.metrics import ServeMetrics
+
+        metrics = ServeMetrics()
+        # provenance rides the snapshot meta: check_regression's
+        # device-mismatch guard reads it, so a CPU-smoke snapshot can
+        # never silently gate real-chip numbers
+        metrics_meta = capture_provenance()
+        if metrics_port is not None:
+            try:
+                exporter = MetricsExporter(
+                    metrics.registry, port=metrics_port,
+                    snapshot_path=metrics_snapshot,
+                    meta=metrics_meta).start()
+            except OSError as e:
+                raise SystemExit(
+                    f"apex-tpu-bench: cannot bind --metrics-port "
+                    f"{metrics_port}: {e}")
+            print(f"apex-tpu-bench: metrics at {exporter.url}",
+                  file=sys.stderr)
     cfg = GPT2Config.tiny()
     if max_len > cfg.n_positions:
         # the tiny preset caps context at its n_positions; a deeper bench
@@ -349,7 +394,7 @@ def _serve_bench(steps: int, num_slots: int = 4,
 
         admission = AdmissionController(max_queue=max_queue,
                                         shed_policy=shed_policy)
-    sched = ServeScheduler(engine, admission=admission)
+    sched = ServeScheduler(engine, admission=admission, metrics=metrics)
     # enough requests to keep every slot busy and exercise backfill
     n_requests = max(2 * num_slots, (steps * num_slots) // 8 + 1)
     system = [int(t) for t in rng.randint(0, cfg.vocab_size,
@@ -359,10 +404,24 @@ def _serve_bench(steps: int, num_slots: int = 4,
         tail = [int(t) for t in rng.randint(0, cfg.vocab_size, plen)]
         sched.submit(Request(
             request_id=f"bench-{i}", tokens=system + tail,
-            max_new_tokens=8, deadline_ms=deadline_ms))
+            max_new_tokens=8, deadline_ms=deadline_ms,
+            tenant=f"tenant-{i % tenants}" if tenants > 0 else None))
     t0 = time.perf_counter()
-    stats = sched.run(max_steps=steps)
-    wall = time.perf_counter() - t0
+    try:
+        stats = sched.run(max_steps=steps)
+        # measured BEFORE the finally teardown: exporter.stop() blocks on
+        # the HTTP server's shutdown poll + thread join + snapshot I/O,
+        # and bench_wall_s gates lower-is-better — teardown noise must
+        # not read as a perf regression of the metrics-armed capture
+        wall = time.perf_counter() - t0
+    finally:
+        if exporter is not None:
+            exporter.stop()
+        elif metrics is not None and metrics_snapshot:
+            from apex_tpu.monitor.export import write_snapshot
+
+            write_snapshot(metrics.registry, metrics_snapshot,
+                           meta=metrics_meta)
     s = stats.summary()
     suite = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -549,6 +608,19 @@ def main() -> None:
                             default=None,
                             help="write the capture as a suite JSON "
                                  "(default BENCH_BASELINE_SERVE.json)")
+            ap.add_argument("--metrics-port", type=int, default=None,
+                            help="serve live Prometheus /metrics + JSON "
+                                 "/metrics.json while the bench runs "
+                                 "(0 = ephemeral port)")
+            ap.add_argument("--metrics-snapshot", default=None,
+                            help="commit an atomic mergeable metrics "
+                                 "snapshot at exit (gateable by "
+                                 "check_regression, mergeable by "
+                                 "tools/metrics_merge.py)")
+            ap.add_argument("--tenants", type=int, default=0,
+                            help="label the scripted workload round-"
+                                 "robin across N tenants (per-tenant "
+                                 "series in the live metrics)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -560,7 +632,10 @@ def main() -> None:
                          shared_prefix=args.shared_prefix,
                          page_size=args.page_size,
                          num_pages=args.num_pages,
-                         prefix_cache=args.prefix_cache)
+                         prefix_cache=args.prefix_cache,
+                         metrics_port=args.metrics_port,
+                         metrics_snapshot=args.metrics_snapshot,
+                         tenants=args.tenants)
         elif has_telemetry:
             import argparse
 

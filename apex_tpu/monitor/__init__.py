@@ -23,13 +23,26 @@ ranges and per-example AverageMeters). Cooperating pieces:
 - :mod:`~apex_tpu.monitor.flight` — :class:`FlightRecorder`: bounded ring
   of bus events + open spans + memory + thread stacks, dumped atomically
   on watchdog escalation / preemption / fatal exceptions.
+- :mod:`~apex_tpu.monitor.export` — live metrics: the streaming
+  :class:`MetricsRegistry` (counters, gauges, log-bucketed **mergeable**
+  histograms), Prometheus-text/JSON export, the stdlib
+  :class:`MetricsExporter` pull endpoint, and atomic snapshot files that
+  ``tools/metrics_merge.py`` folds into one fleet view.
+- :mod:`~apex_tpu.monitor.slo` — :class:`SLOTracker`: declarative
+  objectives over short/long rolling windows with multi-window burn
+  rates, publishing ``serve_slo_breach``/``serve_slo_recovered``.
 
-``tools/check_regression.py`` turns the emitted JSONL into a CI gate
-against a committed bench baseline. See docs/observability.md.
+``tools/check_regression.py`` turns the emitted JSONL (or a metrics
+snapshot) into a CI gate against a committed bench baseline. See
+docs/observability.md.
 """
 
+from apex_tpu.monitor.export import (  # noqa: F401
+    MetricsExporter, MetricsRegistry, histogram_quantile, merge_snapshots,
+    percentile, snapshot_to_prometheus, write_snapshot)
 from apex_tpu.monitor.flight import FlightRecorder, thread_stacks  # noqa: F401
 from apex_tpu.monitor.goodput import EVENT_SCHEMA, GoodputLedger  # noqa: F401
+from apex_tpu.monitor.slo import SLObjective, SLOTracker  # noqa: F401
 from apex_tpu.monitor.memory import (  # noqa: F401
     MemoryAccountant, device_memory_stats, publish_compiled_memory,
     sample_device_memory)
@@ -48,4 +61,7 @@ __all__ = [
     "set_tracer", "read_chrome_trace", "spans_by_trace", "FlightRecorder",
     "thread_stacks", "MemoryAccountant", "device_memory_stats",
     "publish_compiled_memory", "sample_device_memory",
+    "MetricsRegistry", "MetricsExporter", "percentile",
+    "histogram_quantile", "merge_snapshots", "snapshot_to_prometheus",
+    "write_snapshot", "SLObjective", "SLOTracker",
 ]

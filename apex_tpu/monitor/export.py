@@ -1,0 +1,676 @@
+"""Live-metrics registry — streaming counters/gauges/histograms with
+exact cross-instance merge, Prometheus/JSON export, and a pull endpoint.
+
+The serving scheduler's end-of-run ``summary()`` sorts full in-memory
+latency lists — exact, but post-hoc and unbounded. This module is the
+*live* layer: a :class:`MetricsRegistry` of
+
+- :class:`Counter` — monotonic totals (``serve_requests_completed_total``),
+- :class:`Gauge` — point-in-time levels (``serve_resident_tokens``) with a
+  declared merge aggregation (sum/max/min/last),
+- :class:`Histogram` — **log-bucketed mergeable** distributions: fixed
+  bucket boundaries ``HIST_LO * HIST_GROWTH**i`` shared by every instance,
+  O(1) record, bounded memory (at most :data:`HIST_MAX_INDEX` sparse
+  buckets), and **exact merge**: because the boundaries are fixed and
+  global, summing two histograms' bucket counts is bit-identical to having
+  recorded the union stream into one histogram — the aggregation seam
+  per-rank/per-run snapshots (and the coming tensor-parallel serving
+  ranks) merge through.
+
+**Quantile error bound.** :meth:`Histogram.quantile` returns the upper
+edge of the bucket holding the exact nearest-rank percentile (the same
+rank rule as :func:`percentile`, the repo's one exact-percentile helper).
+For an exact value ``q`` in ``[HIST_LO, HIST_LO * HIST_GROWTH**HIST_MAX_INDEX]``
+the estimate ``e`` satisfies ``q <= e < q * HIST_GROWTH`` — a relative
+overestimate below :data:`QUANTILE_REL_ERROR` (≈ 9.1% at the default
+``2**(1/8)`` growth). Below ``HIST_LO`` the estimate is ``HIST_LO``
+(absolute error ≤ 1µs for second-valued series). Tier-1 holds the
+scheduler's exact sorted-list percentiles against this bound.
+
+**Label cardinality is bounded.** A family created with labels folds
+series past ``max_series`` into the ``__other__`` catch-all, so a tenant
+explosion can never make the registry (or a scrape) unbounded.
+
+**Export surfaces** — all host-side, never on a traced path (apexlint
+APX001 flags a registry mutation reachable from traced code):
+
+- :meth:`MetricsRegistry.snapshot` — the JSON document
+  (``schema: "apex_tpu.metrics/v1"``) that :func:`merge_snapshots` folds
+  across instances/ranks/runs and ``tools/metrics_merge.py`` exposes as a
+  CLI; :func:`write_snapshot` commits it atomically (``.tmp`` +
+  ``os.replace``, the APX004 durability contract).
+- :func:`snapshot_to_prometheus` — text exposition (format 0.0.4) of a
+  snapshot; :meth:`MetricsRegistry.prometheus_text` is the live spelling.
+- :class:`MetricsExporter` — a stdlib ``http.server`` pull endpoint
+  (``/metrics`` Prometheus text, ``/metrics.json`` JSON snapshot) on a
+  daemon thread; every scrape publishes a ``metrics_scrape`` bus event.
+
+This module is deliberately **stdlib-only at import time** (the bus
+import is call-site deferred) so ``tools/metrics_merge.py`` can load it
+standalone — merging rank snapshots on a machine with no jax installed.
+See docs/observability.md "Live metrics, SLOs, and fleet aggregation".
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+SNAPSHOT_SCHEMA = "apex_tpu.metrics/v1"
+
+# fixed, global histogram geometry: every histogram everywhere buckets by
+# upper_bound(i) = HIST_LO * HIST_GROWTH**i — merge is exact only because
+# no instance can choose different boundaries
+HIST_LO = 1e-6                 # bucket 0 holds everything <= 1µs (seconds)
+HIST_GROWTH = 2.0 ** 0.125     # 8 buckets per doubling
+HIST_MAX_INDEX = 384           # upper bound ≈ 2.8e8 s — the overflow bucket
+# documented relative quantile error (overestimate) inside the bucketed
+# range: the estimate is the bucket's upper edge, the exact value is past
+# the previous edge, and the two differ by one growth factor
+QUANTILE_REL_ERROR = HIST_GROWTH - 1.0
+
+OVERFLOW_LABEL = "__other__"   # where series past max_series fold
+
+GAUGE_AGGS = ("sum", "max", "min", "last")
+
+
+def percentile(values: Iterable[float], p: float) -> float:
+    """THE repo's exact nearest-rank percentile: the value at 1-based rank
+    ``ceil(p * n)`` of the sorted values (``p=0`` → the minimum; empty →
+    0.0). Shared by the scheduler's exact end-of-run summary and the
+    histogram-quantile tests so the two can never round differently —
+    the bug this replaced: ``summary()`` used ``len//2`` indexing for
+    TTFT but round-half-even linear indexing for step percentiles."""
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    rank = max(1, math.ceil(p * len(vals)))
+    return vals[min(rank, len(vals)) - 1]
+
+
+def bucket_index(value: float) -> int:
+    """The fixed-boundary bucket for ``value``: 0 for anything at or
+    below ``HIST_LO`` (NaN included — a poisoned sample must not crash
+    accounting), the overflow bucket for anything past the range."""
+    v = float(value)
+    if not v > HIST_LO:          # also catches NaN
+        return 0
+    if math.isinf(v):
+        return HIST_MAX_INDEX
+    idx = math.ceil(math.log(v / HIST_LO) / math.log(HIST_GROWTH))
+    return max(0, min(HIST_MAX_INDEX, idx))
+
+
+def bucket_upper(idx: int) -> float:
+    """Upper edge of bucket ``idx`` (the quantile estimate for any value
+    that landed in it)."""
+    return HIST_LO * HIST_GROWTH ** idx
+
+
+def histogram_quantile(buckets: Mapping[Any, int], count: int,
+                       p: float, *, lo: float = HIST_LO,
+                       growth: float = HIST_GROWTH) -> float:
+    """Nearest-rank quantile over a (possibly merged) bucket-count map —
+    the same rank rule as :func:`percentile`, so the streaming estimate
+    and the exact oracle walk to the same sample's bucket. ``lo`` /
+    ``growth`` default to the global geometry; a caller reading a
+    serialized snapshot passes the SNAPSHOT'S own values (the one
+    quantile rule — ``tools/check_regression.py`` loads this module by
+    path rather than growing a second copy)."""
+    if count <= 0:
+        return 0.0
+    rank = max(1, math.ceil(p * count))
+    cum = 0
+    upper = 0.0
+    for idx in sorted(int(k) for k in buckets):
+        cum += int(buckets[idx] if idx in buckets else buckets[str(idx)])
+        upper = lo * growth ** idx
+        if cum >= rank:
+            return upper
+    return upper
+
+
+# --------------------------------------------------------------- metrics
+
+class Counter:
+    """Monotonic total. ``inc()`` is O(1) host work under the registry
+    lock; merge across snapshots is addition."""
+
+    kind = "counter"
+
+    def __init__(self, lock: threading.Lock, labels: Dict[str, str]):
+        self._lock = lock
+        self.labels = labels
+        self._value = 0.0
+
+    def inc(self, value: float = 1.0) -> None:
+        if value < 0:
+            raise ValueError(f"counter increment must be >= 0: {value}")
+        with self._lock:
+            self._value += float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def state(self) -> Dict[str, Any]:
+        # caller holds self._lock (registry snapshot)
+        return {"labels": dict(self.labels), "value": self._value}
+
+
+class Gauge:
+    """Point-in-time level. The family's ``agg`` declares how instances
+    merge across a fleet (sum resident tokens, min free-page fraction)."""
+
+    kind = "gauge"
+
+    def __init__(self, lock: threading.Lock, labels: Dict[str, str]):
+        self._lock = lock
+        self.labels = labels
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, value: float = 1.0) -> None:
+        with self._lock:
+            self._value += float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def state(self) -> Dict[str, Any]:
+        # caller holds self._lock (registry snapshot)
+        return {"labels": dict(self.labels), "value": self._value}
+
+
+class Histogram:
+    """Log-bucketed streaming distribution: O(1) :meth:`record`, bounded
+    sparse bucket map, exact merge (fixed global boundaries)."""
+
+    kind = "histogram"
+
+    def __init__(self, lock: threading.Lock, labels: Dict[str, str]):
+        self._lock = lock
+        self.labels = labels
+        self._count = 0
+        self._sum = 0.0
+        self._min: Optional[float] = None
+        self._max: Optional[float] = None
+        self._buckets: Dict[int, int] = {}
+
+    def record(self, value: float) -> None:
+        v = float(value)
+        idx = bucket_index(v)
+        # a poisoned sample (NaN/inf) is COUNTED (bucket 0 / overflow)
+        # but must not contaminate sum/min/max: one NaN would make the
+        # sum NaN forever, and NaN/Infinity are not valid JSON — a
+        # single bad sample would break every later /metrics.json scrape
+        finite = math.isfinite(v)
+        with self._lock:
+            self._count += 1
+            if finite:
+                self._sum += v
+                if self._min is None or v < self._min:
+                    self._min = v
+                if self._max is None or v > self._max:
+                    self._max = v
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+
+    # prometheus spelling, same O(1) path
+    observe = record
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def quantile(self, p: float) -> float:
+        """Streaming nearest-rank quantile: exact value ``q`` →
+        estimate in ``[q, q * HIST_GROWTH)`` (see module docstring)."""
+        with self._lock:
+            buckets = dict(self._buckets)
+            count = self._count
+        return histogram_quantile(buckets, count, p)
+
+    def state(self) -> Dict[str, Any]:
+        # caller holds self._lock (registry snapshot)
+        return {"labels": dict(self.labels), "count": self._count,
+                "sum": self._sum, "min": self._min, "max": self._max,
+                "buckets": {str(i): n
+                            for i, n in sorted(self._buckets.items())}}
+
+
+_KIND_CLS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class _Family:
+    """One named metric family: its label names, bounded series map, and
+    convenience delegates for the unlabeled case."""
+
+    def __init__(self, registry: "MetricsRegistry", name: str, kind: str,
+                 help: str, label_names: Tuple[str, ...], max_series: int,
+                 agg: str):
+        self.registry = registry
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.label_names = label_names
+        self.max_series = max_series
+        self.agg = agg
+        self._series: Dict[Tuple[str, ...], Any] = {}
+
+    def labels(self, **labels: str):
+        """The series for this label set — created on first use; once the
+        family holds ``max_series`` series, NEW label sets fold into the
+        ``__other__`` series so cardinality (and scrape size) stays
+        bounded whatever the tenant population does."""
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared "
+                f"{sorted(self.label_names)}")
+        key = tuple(str(labels[n]) for n in self.label_names)
+        lock = self.registry._lock
+        with lock:
+            series = self._series.get(key)
+            if series is None:
+                if len(self._series) >= self.max_series:
+                    key = tuple(OVERFLOW_LABEL for _ in self.label_names)
+                    series = self._series.get(key)
+                if series is None:
+                    series = _KIND_CLS[self.kind](
+                        lock, dict(zip(self.label_names, key)))
+                    self._series[key] = series
+            return series
+
+    # unlabeled ergonomics: family.inc()/record()/set() hit the () series
+    def inc(self, value: float = 1.0, **labels) -> None:
+        self.labels(**labels).inc(value)
+
+    def set(self, value: float, **labels) -> None:
+        self.labels(**labels).set(value)
+
+    def record(self, value: float, **labels) -> None:
+        self.labels(**labels).record(value)
+
+    def series(self) -> List[Any]:
+        with self.registry._lock:
+            return list(self._series.values())
+
+    def state(self) -> Dict[str, Any]:
+        # caller holds self._lock (registry snapshot)
+        out: Dict[str, Any] = {"type": self.kind, "help": self.help,
+                               "labels": list(self.label_names),
+                               "series": [s.state()
+                                          for s in self._series.values()]}
+        if self.kind == "gauge":
+            out["agg"] = self.agg
+        if self.kind == "histogram":
+            out["lo"] = HIST_LO
+            out["growth"] = HIST_GROWTH
+        return out
+
+
+class MetricsRegistry:
+    """Named counter/gauge/histogram families behind ONE process-local
+    lock (every record is a handful of host float ops — contention is
+    irrelevant next to a decode step, and one lock keeps the APX002
+    discipline trivial). Family getters are idempotent: asking again
+    with the same name returns the existing family; a kind mismatch is a
+    loud ValueError, never silent aliasing."""
+
+    def __init__(self, *, default_max_series: int = 64):
+        self.default_max_series = int(default_max_series)
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str, help: str,
+                labels: Sequence[str], max_series: Optional[int],
+                agg: str = "sum") -> _Family:
+        if agg not in GAUGE_AGGS:
+            raise ValueError(f"gauge agg {agg!r} not in {GAUGE_AGGS}")
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{fam.kind}, not {kind}")
+                return fam
+            fam = _Family(self, name, kind, help, tuple(labels),
+                          int(max_series or self.default_max_series), agg)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Sequence[str] = (),
+                max_series: Optional[int] = None) -> _Family:
+        return self._family(name, "counter", help, labels, max_series)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Sequence[str] = (),
+              max_series: Optional[int] = None,
+              agg: str = "sum") -> _Family:
+        return self._family(name, "gauge", help, labels, max_series, agg)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Sequence[str] = (),
+                  max_series: Optional[int] = None) -> _Family:
+        return self._family(name, "histogram", help, labels, max_series)
+
+    def families(self) -> List[_Family]:
+        with self._lock:
+            return list(self._families.values())
+
+    # ---- export ---------------------------------------------------------
+    def snapshot(self, meta: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        """The mergeable JSON document: plain data, no object refs —
+        ``merge_snapshots`` folds any number of these into one."""
+        with self._lock:
+            metrics = {name: fam.state()
+                       for name, fam in sorted(self._families.items())}
+        doc: Dict[str, Any] = {"schema": SNAPSHOT_SCHEMA,
+                               "metrics": metrics}
+        if meta:
+            doc["meta"] = dict(meta)
+        return doc
+
+    def prometheus_text(self) -> str:
+        return snapshot_to_prometheus(self.snapshot())
+
+
+# ------------------------------------------------------- snapshot algebra
+
+def _series_key(labels: Mapping[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def merge_snapshots(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold N snapshot documents into one fleet view: counters add,
+    gauges combine by their declared ``agg``, histograms add per-bucket —
+    **exactly** equal to having recorded the union stream, because every
+    instance shares the fixed global bucket boundaries. Raises
+    ``ValueError`` on schema/type/geometry mismatches (merging
+    incompatible captures would silently fabricate a fleet view)."""
+    if not docs:
+        raise ValueError("merge_snapshots needs at least one snapshot")
+    for doc in docs:
+        if doc.get("schema") != SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"not a metrics snapshot (schema="
+                f"{doc.get('schema')!r}, want {SNAPSHOT_SCHEMA!r})")
+    merged_metrics: Dict[str, Any] = {}
+    for doc in docs:
+        for name, fam in doc.get("metrics", {}).items():
+            out = merged_metrics.get(name)
+            if out is None:
+                out = {k: v for k, v in fam.items() if k != "series"}
+                out["series"] = {}
+                merged_metrics[name] = out
+            elif out["type"] != fam["type"]:
+                raise ValueError(
+                    f"metric {name!r}: type mismatch across snapshots "
+                    f"({out['type']} vs {fam['type']})")
+            elif fam["type"] == "histogram" and (
+                    out.get("lo") != fam.get("lo")
+                    or out.get("growth") != fam.get("growth")):
+                raise ValueError(
+                    f"metric {name!r}: histogram geometry mismatch — "
+                    f"buckets are only mergeable at identical lo/growth")
+            elif fam["type"] == "gauge" and \
+                    out.get("agg", "sum") != fam.get("agg", "sum"):
+                # the one field where merge SEMANTICS differ per
+                # declaration: first-doc-wins would silently fold under
+                # the wrong aggregation — refuse like type/geometry
+                raise ValueError(
+                    f"metric {name!r}: gauge agg mismatch across "
+                    f"snapshots ({out.get('agg', 'sum')} vs "
+                    f"{fam.get('agg', 'sum')})")
+            for series in fam.get("series", []):
+                key = _series_key(series.get("labels", {}))
+                slot = out["series"].get(key)
+                if slot is None:
+                    out["series"][key] = json.loads(json.dumps(series))
+                elif fam["type"] == "counter":
+                    slot["value"] += series["value"]
+                elif fam["type"] == "gauge":
+                    agg = out.get("agg", "sum")
+                    if agg == "sum":
+                        slot["value"] += series["value"]
+                    elif agg == "max":
+                        slot["value"] = max(slot["value"], series["value"])
+                    elif agg == "min":
+                        slot["value"] = min(slot["value"], series["value"])
+                    else:  # "last": later snapshots win, in argument order
+                        slot["value"] = series["value"]
+                else:  # histogram: the exact merge
+                    slot["count"] += series["count"]
+                    slot["sum"] += series["sum"]
+                    for bound in ("min", "max"):
+                        vals = [v for v in (slot.get(bound),
+                                            series.get(bound))
+                                if v is not None]
+                        if vals:
+                            slot[bound] = (min(vals) if bound == "min"
+                                           else max(vals))
+                    buckets = slot["buckets"]
+                    for idx, n in series.get("buckets", {}).items():
+                        buckets[idx] = buckets.get(idx, 0) + n
+    for fam in merged_metrics.values():
+        fam["series"] = [fam["series"][k] for k in sorted(fam["series"])]
+    # provenance must survive the merge: check_regression's
+    # device-mismatch guard reads snapshot meta, and a fleet view that
+    # dropped it would let a CPU-smoke rank silently gate real-chip
+    # numbers. Keys every input agrees on pass through; conflicting
+    # values join with "|" so the guard flags the mix loudly.
+    meta: Dict[str, Any] = {"merged_from": len(docs)}
+    for key in ("device_kind", "interpret_mode", "chip", "backend", "git"):
+        vals: List[Any] = []
+        for doc in docs:
+            m = doc.get("meta")
+            if isinstance(m, dict) and key in m and m[key] not in vals:
+                vals.append(m[key])
+        if len(vals) == 1:
+            meta[key] = vals[0]    # raw value: a bool must stay a bool
+        elif vals:
+            # a mixed fleet (cpu rank merged with a tpu rank) must read
+            # as NEITHER side — the joined spelling mismatches any
+            # homogeneous baseline, so the gate flags it loudly
+            meta[key] = "|".join(sorted(str(v) for v in vals))
+    return {"schema": SNAPSHOT_SCHEMA, "metrics": merged_metrics,
+            "meta": meta}
+
+
+def _format_labels(labels: Mapping[str, str],
+                   extra: Optional[Tuple[str, str]] = None) -> str:
+    items = sorted((str(k), str(v)) for k, v in labels.items())
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    def esc(v: str) -> str:
+        return v.replace("\\", r"\\").replace('"', r'\"').replace(
+            "\n", r"\n")
+    return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
+
+
+def _fmt(v: float) -> str:
+    return f"{float(v):.10g}"
+
+
+def snapshot_to_prometheus(doc: Dict[str, Any]) -> str:
+    """Render a snapshot document in the Prometheus text exposition
+    format (0.0.4): counters/gauges one sample per series, histograms as
+    cumulative ``_bucket{le=...}`` lines over the POPULATED buckets plus
+    ``+Inf``/``_sum``/``_count``."""
+    lines: List[str] = []
+    for name, fam in sorted(doc.get("metrics", {}).items()):
+        if fam.get("help"):
+            lines.append(f"# HELP {name} {fam['help']}")
+        lines.append(f"# TYPE {name} {fam['type']}")
+        # bucket edges come from the SNAPSHOT'S serialized geometry, not
+        # this module's constants: a snapshot captured under different
+        # lo/growth must render its own ``le`` labels, never ours
+        lo = float(fam.get("lo", HIST_LO))
+        growth = float(fam.get("growth", HIST_GROWTH))
+        for series in fam.get("series", []):
+            labels = series.get("labels", {})
+            if fam["type"] in ("counter", "gauge"):
+                lines.append(f"{name}{_format_labels(labels)} "
+                             f"{_fmt(series['value'])}")
+                continue
+            cum = 0
+            for idx in sorted(int(k) for k in series.get("buckets", {})):
+                cum += series["buckets"][str(idx)]
+                le = _fmt(lo * growth ** idx)
+                lines.append(
+                    f"{name}_bucket{_format_labels(labels, ('le', le))} "
+                    f"{cum}")
+            lines.append(
+                f"{name}_bucket{_format_labels(labels, ('le', '+Inf'))} "
+                f"{series['count']}")
+            lines.append(f"{name}_sum{_format_labels(labels)} "
+                         f"{_fmt(series['sum'])}")
+            lines.append(f"{name}_count{_format_labels(labels)} "
+                         f"{series['count']}")
+    return "\n".join(lines) + "\n"
+
+
+def atomic_write_json(path: str, doc: Dict[str, Any]) -> str:
+    """Commit a JSON document atomically: stage to ``.tmp``, publish with
+    one ``os.replace`` — a crash mid-write leaves the previous complete
+    file, never a torn one (the repo-wide APX004 contract)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, default=float)
+    os.replace(tmp, path)
+    return path
+
+
+def atomic_write_text(path: str, text: str) -> str:
+    """Same ``.tmp`` + ``os.replace`` commit for a text artifact (the
+    merged Prometheus rendering ``tools/metrics_merge.py`` can emit)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+def write_snapshot(registry: MetricsRegistry, path: str,
+                   meta: Optional[Dict[str, Any]] = None) -> str:
+    """Atomic snapshot-file mode: one mergeable document per rank/run on
+    disk, for ``tools/metrics_merge.py`` to fold into the fleet view."""
+    atomic_write_json(path, registry.snapshot(meta=meta))
+    # deferred import: this module stays stdlib-importable standalone
+    from apex_tpu.utils.logging import publish_event
+
+    publish_event("metrics_snapshot", path=path)
+    return path
+
+
+# ------------------------------------------------------------- exporter
+
+def _make_handler(registry: MetricsRegistry,
+                  meta: Optional[Dict[str, Any]]):
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0]
+            if path in ("/", "/metrics"):
+                body = registry.prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path in ("/metrics.json", "/snapshot", "/snapshot.json"):
+                body = json.dumps(registry.snapshot(meta=meta),
+                                  sort_keys=True, default=float).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404, "try /metrics or /metrics.json")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            # deferred import keeps the module standalone-importable
+            from apex_tpu.utils.logging import publish_event
+
+            publish_event("metrics_scrape", path=path, bytes=len(body))
+
+        def log_message(self, format, *args):
+            # the default writes one stderr line per scrape — a 10s
+            # Prometheus cadence must not spam the serving console
+            pass
+
+    return _Handler
+
+
+class MetricsExporter:
+    """Pull endpoint over a registry: ``/metrics`` (Prometheus text) and
+    ``/metrics.json`` (the mergeable snapshot) from a stdlib
+    ``ThreadingHTTPServer`` on a daemon thread. ``port=0`` binds an
+    ephemeral port (read :attr:`port` after :meth:`start`).
+    ``snapshot_path=`` additionally commits an atomic snapshot file at
+    :meth:`stop` — the per-rank artifact ``tools/metrics_merge.py``
+    merges. Scrapes are host-side HTTP work on their own thread: the
+    decode loop never sees them (tier-1 scrapes a live serve loop and
+    asserts ``decode_traces == 1``)."""
+
+    def __init__(self, registry: MetricsRegistry, *, port: int = 0,
+                 host: str = "127.0.0.1",
+                 snapshot_path: Optional[str] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.registry = registry
+        self.host = host
+        self.port = int(port)
+        self.snapshot_path = snapshot_path
+        self.meta = meta
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "MetricsExporter":
+        if self._server is not None:
+            return self
+        self._server = ThreadingHTTPServer(
+            (self.host, self.port), _make_handler(self.registry, self.meta))
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="apex-tpu-metrics",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+            if self._thread is not None:
+                self._thread.join(timeout=2.0)
+                self._thread = None
+        if self.snapshot_path:
+            write_snapshot(self.registry, self.snapshot_path,
+                           meta=self.meta)
+
+    def __enter__(self) -> "MetricsExporter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

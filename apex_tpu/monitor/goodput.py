@@ -76,6 +76,10 @@ COUNTED_EVENTS = (
     # a prefix-cache hit at admission: hit_tokens were served from
     # resident read-only pages instead of being re-prefilled
     "serve_prefix_hit",
+    # live SLO tracking (monitor.slo): an objective's multi-window burn
+    # rate crossed the breach condition / dropped back under it — one
+    # event per transition, never one per tick
+    "serve_slo_breach", "serve_slo_recovered",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem
@@ -88,6 +92,9 @@ INFO_EVENTS = (
     "kernel_autotune", "kernel_autotune_failed", "tune_cache_corrupt",
     "preemption_guard_inert",
     "checkpoint_publish_failed", "checkpoint_quarantine_failed",
+    # live-metrics export (monitor.export): a pull-endpoint scrape was
+    # served / an atomic snapshot file was committed
+    "metrics_scrape", "metrics_snapshot",
 )
 
 # THE event-name schema: every literal publish_event/structured_warning

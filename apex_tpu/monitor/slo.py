@@ -1,0 +1,288 @@
+"""Live SLO tracking — declarative objectives, rolling windows,
+multi-window burn rates, breach/recovery bus events.
+
+An SLO here is an **error-budget objective**: "at most ``bad_frac_budget``
+of events may be bad". The three serving objectives ship as constructors
+(docs/observability.md "Live metrics, SLOs, and fleet aggregation"):
+
+- :meth:`SLObjective.ttft_p99_ms` — "99% of completed requests reach
+  their first token within N ms": a completion is *bad* when its TTFT
+  exceeds the threshold; the budget is ``1 - 0.99``.
+- :meth:`SLObjective.deadline_miss_frac` — at most this fraction of
+  terminal requests expire on their deadline.
+- :meth:`SLObjective.shed_frac` — at most this fraction of submitted
+  requests are shed/rejected by admission control.
+
+**Multi-window burn rate.** Each objective keeps two rolling windows of
+(good, bad) events. The *burn rate* of a window is
+``bad_frac / bad_frac_budget`` — 1.0 means the error budget is being
+consumed exactly as fast as it accrues; 10 means ten times too fast. A
+**breach** fires when the short AND long windows both burn at or above
+``burn_factor`` (and the short window holds at least ``min_events``
+events): the short window proves the damage is happening *now*, the long
+window that it is not a blip — the standard SRE double condition that
+keeps one bad tick from paging. **Recovery** fires when the short-window
+burn drops back below the factor: the condition creating new damage has
+stopped (the long window still remembers it, by design — re-breach is
+cheap if it resumes).
+
+Transitions publish ``serve_slo_breach`` / ``serve_slo_recovered`` on
+the process event bus (registered in the goodput ``EVENT_SCHEMA``), so
+the goodput ledger counts them, the Telemetry JSONL mirrors them, and
+the flight recorder's ring holds them at crash time — zero wiring, the
+PR-2 contract. The tracker is pure host-side bookkeeping on monotonic
+clocks (``time.perf_counter``; APX005), driven by the serving scheduler
+through :class:`~apex_tpu.serve.metrics.ServeMetrics`.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import math
+import time
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+from apex_tpu.utils.logging import publish_event
+
+# event sources an objective can observe (ServeMetrics feeds these)
+SOURCES = ("ttft", "deadline", "shed")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLObjective:
+    """One declarative objective. ``source`` names the event stream it
+    consumes; ``threshold_s`` (latency objectives) classifies a sample
+    as bad; ``bad_frac_budget`` is the error budget."""
+
+    name: str
+    source: str
+    bad_frac_budget: float
+    threshold_s: Optional[float] = None
+    short_window_s: float = 60.0
+    long_window_s: float = 300.0
+    burn_factor: float = 1.0
+    min_events: int = 8
+
+    def __post_init__(self):
+        if self.source not in SOURCES:
+            raise ValueError(
+                f"SLO source {self.source!r} not in {SOURCES}")
+        if not 0.0 < self.bad_frac_budget <= 1.0:
+            raise ValueError(
+                f"bad_frac_budget must be in (0, 1]: "
+                f"{self.bad_frac_budget}")
+        if self.short_window_s <= 0:
+            # a zero/negative span would prune every event at each
+            # evaluate(): min_events never reached, a breach can never
+            # fire — the tracker would be armed but structurally inert
+            raise ValueError(
+                f"window spans must be positive: "
+                f"short={self.short_window_s}s long={self.long_window_s}s")
+        if self.short_window_s >= self.long_window_s:
+            raise ValueError(
+                f"short window ({self.short_window_s}s) must be shorter "
+                f"than the long window ({self.long_window_s}s)")
+
+    # ---- the serving objectives ----------------------------------------
+    @staticmethod
+    def ttft_p99_ms(threshold_ms: float, **kw) -> "SLObjective":
+        """99% of completions reach first token within ``threshold_ms``."""
+        return SLObjective(name="ttft_p99_ms", source="ttft",
+                           bad_frac_budget=0.01,
+                           threshold_s=float(threshold_ms) / 1e3, **kw)
+
+    @staticmethod
+    def deadline_miss_frac(budget: float, **kw) -> "SLObjective":
+        """At most ``budget`` of terminal requests miss their deadline."""
+        return SLObjective(name="deadline_miss_frac", source="deadline",
+                           bad_frac_budget=float(budget), **kw)
+
+    @staticmethod
+    def shed_frac(budget: float, **kw) -> "SLObjective":
+        """At most ``budget`` of submissions are shed by admission."""
+        return SLObjective(name="shed_frac", source="shed",
+                           bad_frac_budget=float(budget), **kw)
+
+
+class _Window:
+    """Rolling (good, bad) event window: O(1) amortized add/prune with
+    running totals — evaluation never rescans the event list."""
+
+    def __init__(self, span_s: float):
+        self.span_s = float(span_s)
+        self._events: Deque[Tuple[float, bool]] = collections.deque()
+        self.total = 0
+        self.bad = 0
+
+    def add(self, t: float, bad: bool) -> None:
+        self._events.append((t, bad))
+        self.total += 1
+        self.bad += int(bad)
+
+    def prune(self, now: float) -> None:
+        horizon = now - self.span_s
+        while self._events and self._events[0][0] < horizon:
+            _, bad = self._events.popleft()
+            self.total -= 1
+            self.bad -= int(bad)
+
+    @property
+    def bad_frac(self) -> float:
+        return self.bad / self.total if self.total else 0.0
+
+
+class _ObjectiveState:
+    def __init__(self, obj: SLObjective):
+        self.obj = obj
+        self.short = _Window(obj.short_window_s)
+        self.long = _Window(obj.long_window_s)
+        self.breached = False
+        self.breaches = 0      # lifetime transition count
+
+    def burn(self, window: _Window) -> float:
+        return window.bad_frac / self.obj.bad_frac_budget
+
+
+class SLOTracker:
+    """Evaluate a set of :class:`SLObjective` over live event streams.
+
+    ``observe(source, value=..., bad=...)`` feeds every objective bound
+    to ``source``; ``evaluate()`` (the scheduler calls it once per tick)
+    prunes windows, recomputes burn rates, and publishes exactly one
+    ``serve_slo_breach`` / ``serve_slo_recovered`` event per state
+    transition — a sustained storm raises ONE breach, its end ONE
+    recovery, never a flap per tick (tier-1 asserts the exact pair).
+
+    Single-threaded by contract: driven from the scheduler tick under the
+    scheduler's lock (the same discipline as the admission controller).
+    ``clock`` is injectable for deterministic tests; it must be a
+    monotonic source (the default is ``time.perf_counter``)."""
+
+    def __init__(self, objectives: Sequence[SLObjective], *,
+                 clock=time.perf_counter):
+        if not objectives:
+            raise ValueError("SLOTracker needs at least one objective")
+        names = [o.name for o in objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names: {names}")
+        self.clock = clock
+        self._states = {o.name: _ObjectiveState(o) for o in objectives}
+
+    @property
+    def objectives(self) -> List[SLObjective]:
+        return [s.obj for s in self._states.values()]
+
+    def observe(self, source: str, *, value: Optional[float] = None,
+                bad: Optional[bool] = None,
+                t: Optional[float] = None) -> None:
+        """One event on ``source``: either a measured ``value`` (latency
+        objectives classify it against their threshold) or an explicit
+        ``bad`` verdict (fraction objectives)."""
+        now = self.clock() if t is None else t
+        for state in self._states.values():
+            obj = state.obj
+            if obj.source != source:
+                continue
+            if obj.threshold_s is not None:
+                if value is None:
+                    continue    # a verdict-only event carries no latency
+                is_bad = float(value) > obj.threshold_s
+            elif bad is not None:
+                is_bad = bool(bad)
+            else:
+                continue
+            state.short.add(now, is_bad)
+            state.long.add(now, is_bad)
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Prune windows, recompute burns, publish transitions. Returns
+        the transition records (empty on the steady state)."""
+        now = self.clock() if now is None else now
+        transitions: List[Dict[str, Any]] = []
+        for state in self._states.values():
+            obj = state.obj
+            state.short.prune(now)
+            state.long.prune(now)
+            burn_short = state.burn(state.short)
+            burn_long = state.burn(state.long)
+            hot = (burn_short >= obj.burn_factor
+                   and burn_long >= obj.burn_factor
+                   and state.short.total >= obj.min_events)
+            fields = {
+                "objective": obj.name, "source": obj.source,
+                "burn_short": round(burn_short, 4),
+                "burn_long": round(burn_long, 4),
+                "bad_frac_short": round(state.short.bad_frac, 6),
+                "bad_frac_long": round(state.long.bad_frac, 6),
+                "budget": obj.bad_frac_budget,
+            }
+            if obj.threshold_s is not None:
+                fields["threshold_ms"] = obj.threshold_s * 1e3
+            if not state.breached and hot:
+                state.breached = True
+                state.breaches += 1
+                transitions.append(publish_event(
+                    "serve_slo_breach", level="warning", **fields))
+            elif state.breached and burn_short < obj.burn_factor:
+                state.breached = False
+                transitions.append(publish_event(
+                    "serve_slo_recovered", **fields))
+        return transitions
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-objective live state (the CLI prints it; ServeMetrics
+        mirrors the burns into registry gauges per tick)."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, state in self._states.items():
+            obj = state.obj
+            out[name] = {
+                "breached": state.breached,
+                "breaches": state.breaches,
+                "burn_short": round(state.burn(state.short), 4),
+                "burn_long": round(state.burn(state.long), 4),
+                "short_events": state.short.total,
+                "long_events": state.long.total,
+                "budget": obj.bad_frac_budget,
+            }
+            if obj.threshold_s is not None:
+                out[name]["threshold_ms"] = obj.threshold_s * 1e3
+        return out
+
+
+def parse_slo_specs(specs: Sequence[str], *,
+                    short_window_s: Optional[float] = None,
+                    long_window_s: Optional[float] = None,
+                    min_events: Optional[int] = None
+                    ) -> List[SLObjective]:
+    """CLI surface: ``NAME=VALUE`` specs (``ttft_p99_ms=50`` —
+    threshold in ms; ``deadline_miss_frac=0.05`` / ``shed_frac=0.1`` —
+    the error budget). Raises ``ValueError`` with the fix spelled out."""
+    kw: Dict[str, Any] = {}
+    if short_window_s is not None:
+        kw["short_window_s"] = float(short_window_s)
+    if long_window_s is not None:
+        kw["long_window_s"] = float(long_window_s)
+    if min_events is not None:
+        kw["min_events"] = int(min_events)
+    ctors = {"ttft_p99_ms": SLObjective.ttft_p99_ms,
+             "deadline_miss_frac": SLObjective.deadline_miss_frac,
+             "shed_frac": SLObjective.shed_frac}
+    out: List[SLObjective] = []
+    for spec in specs:
+        name, sep, val = spec.partition("=")
+        ctor = ctors.get(name.strip())
+        if ctor is None or not sep:
+            raise ValueError(
+                f"--slo {spec!r}: want NAME=VALUE with NAME one of "
+                f"{sorted(ctors)} (ttft_p99_ms takes a threshold in ms, "
+                f"the _frac objectives take the error budget)")
+        try:
+            v = float(val)
+            if not math.isfinite(v) or v <= 0:
+                raise ValueError(v)
+        except ValueError:
+            raise ValueError(
+                f"--slo {spec!r}: VALUE must be a positive number")
+        out.append(ctor(v, **kw))
+    return out

@@ -115,7 +115,8 @@ class Telemetry:
                  goodput: bool = True,
                  mirror_events: bool = True,
                  flush_every: int = 50,
-                 trace_jsonl: Optional[str] = None):
+                 trace_jsonl: Optional[str] = None,
+                 registry=None):
         if rank_zero_only:
             import jax
 
@@ -151,6 +152,20 @@ class Telemetry:
                                    print_every=console_every, stream=stream)
         self.ledger: Optional[GoodputLedger] = (
             GoodputLedger().attach() if goodput else None)
+        # live-metrics registry (monitor.export): the training-side seam
+        # of the serving SLO layer — step-time lands in a mergeable
+        # histogram so per-rank training snapshots aggregate exactly like
+        # serving ranks do (tools/metrics_merge.py); all ranks record
+        # (fleet view sums), only rank 0 writes files
+        self.registry = registry
+        if registry is not None:
+            self._m_steps = registry.counter(
+                "train_steps_total", "train steps recorded")
+            self._m_skipped = registry.counter(
+                "train_skipped_steps_total",
+                "steps lost to overflow skips")
+            self._m_step_hist = registry.histogram(
+                "train_step_seconds", "wall clock per train step")
         self._unsubscribe = None
         if mirror_events and self.jsonl_path:
             self._unsubscribe = subscribe_events(self._on_event)
@@ -224,6 +239,12 @@ class Telemetry:
             # step/skip with zero seconds rather than dropping it
             self.ledger.record_step(step_ms / 1e3 if step_ms else 0.0,
                                     productive=not skipped)
+        if self.registry is not None:
+            self._m_steps.inc()
+            if skipped:
+                self._m_skipped.inc()
+            if step_ms is not None:
+                self._m_step_hist.record(step_ms / 1e3)
         if self.enabled:
             self.logger.log(step, **fields)
             self._rows_since_flush += 1

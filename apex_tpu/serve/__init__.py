@@ -31,6 +31,11 @@ one invariant:
   rolls back to the last journaled tick; every submitted request reaches
   exactly one terminal status). Per-request deadlines live on
   :class:`Request` (``deadline_ms``) and are swept every tick.
+- :mod:`~apex_tpu.serve.metrics` — :class:`ServeMetrics`: live per-tenant
+  accounting (bounded-cardinality counters, TTFT/latency histograms,
+  occupancy gauges) into an :class:`apex_tpu.monitor.export.MetricsRegistry`
+  plus per-tick SLO burn-rate evaluation — the layer
+  ``--metrics-port``/``--metrics-snapshot`` scrape and merge.
 - :mod:`~apex_tpu.serve.cli` — ``apex-tpu-serve``: load a model config,
   run a scripted or stdin request stream, print per-request stats.
 
@@ -41,6 +46,7 @@ overload/failure contracts.
 from apex_tpu.serve.engine import Engine, EngineConfig  # noqa: F401
 from apex_tpu.serve.kv_cache import (KVCache, evict_slots,  # noqa: F401
                                      init_cache, write_token)
+from apex_tpu.serve.metrics import ServeMetrics  # noqa: F401
 from apex_tpu.serve.resilience import (SHED_POLICIES,  # noqa: F401
                                        AdmissionController,
                                        ServeSupervisor, TickJournal)
@@ -51,5 +57,5 @@ __all__ = [
     "Engine", "EngineConfig", "KVCache", "init_cache", "write_token",
     "evict_slots", "Request", "ServeScheduler", "ServeStats",
     "AdmissionController", "TickJournal", "ServeSupervisor",
-    "SHED_POLICIES",
+    "SHED_POLICIES", "ServeMetrics",
 ]

@@ -34,6 +34,17 @@ so a repeated system prompt is prefilled once. The summary's
 ``prefix_hit_rate`` / ``peak_resident_tokens`` report what the pool
 bought; decode still compiles exactly once (``decode_compiles``).
 
+Live metrics and SLOs (docs/observability.md "Live metrics, SLOs, and
+fleet aggregation"): ``--metrics-port`` serves Prometheus text at
+``/metrics`` + a mergeable JSON snapshot at ``/metrics.json`` while the
+scheduler runs, ``--metrics-snapshot PATH`` commits the snapshot
+atomically at exit (the per-rank artifact ``tools/metrics_merge.py``
+folds into one fleet view), ``--tenants N`` labels the scripted workload
+round-robin so the per-tenant breakdown is visible, and ``--slo
+NAME=VALUE`` (repeatable) arms burn-rate tracked objectives whose
+breach/recovery transitions publish ``serve_slo_breach`` /
+``serve_slo_recovered`` bus events.
+
 Example::
 
     apex-tpu-serve --config tiny --requests 4 --max-new-tokens 8 \
@@ -107,6 +118,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="scripted request count (ignored with --stdin)")
     ap.add_argument("--prompt-len", type=int, default=8,
                     help="scripted prompt length")
+    ap.add_argument("--tenants", type=int, default=0,
+                    help="label scripted requests round-robin across N "
+                         "tenants (tenant-0..tenant-N-1) so the live "
+                         "metrics carry a per-tenant breakdown "
+                         "(0 = unlabeled, the 'default' tenant; "
+                         "incompatible with --stdin)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve live Prometheus-text /metrics + JSON "
+                         "/metrics.json from this port while the "
+                         "scheduler runs (0 = ephemeral; the bound URL "
+                         "prints to stderr)")
+    ap.add_argument("--metrics-snapshot", default=None,
+                    help="commit an atomic mergeable metrics snapshot "
+                         "JSON here at exit — the per-rank artifact "
+                         "tools/metrics_merge.py folds into a fleet view")
+    ap.add_argument("--slo", action="append", default=None,
+                    metavar="NAME=VALUE",
+                    help="arm a live SLO objective (repeatable): "
+                         "ttft_p99_ms=50 (threshold ms), "
+                         "deadline_miss_frac=0.05 / shed_frac=0.1 "
+                         "(error budgets); breaches publish "
+                         "serve_slo_breach on the event bus")
+    ap.add_argument("--slo-window", default=None, metavar="SHORT:LONG",
+                    help="burn-rate window spans in seconds "
+                         "(default 60:300)")
     ap.add_argument("--stdin", action="store_true",
                     help="read one token-id request per input line")
     ap.add_argument("--aot", action="store_true",
@@ -142,6 +178,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"apex-tpu-serve: --max-len {args.max_len} clamped to the "
               f"model's n_positions={max_len}", file=sys.stderr)
 
+    if args.tenants > 0 and args.stdin:
+        # before the stdin read: stdin lines carry no tenant identity to
+        # label — silently dropping the flag would leave every series
+        # under "default" while the user believes the per-tenant
+        # breakdown is armed
+        print("apex-tpu-serve: --tenants labels the SCRIPTED workload; "
+              "it cannot apply to --stdin requests", file=sys.stderr)
+        return 2
+
     # validate the request stream BEFORE paying for params + compiles: a
     # malformed stdin line must fail in milliseconds, not after trace time
     if args.stdin:
@@ -173,6 +218,67 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(prompts[long[0]])} tokens — no room to generate "
               f"under max_len={max_len}", file=sys.stderr)
         return 2
+
+    # SLO specs are usage input: a typo'd objective must fail before the
+    # engine pays for params + compiles
+    slo = None
+    if args.slo_window and not args.slo:
+        # silently ignoring a window spec would leave the user believing
+        # burn-rate tracking is configured — same usage-error contract as
+        # every other inapplicable flag combination here
+        print("apex-tpu-serve: --slo-window needs at least one --slo "
+              "NAME=VALUE objective to apply to", file=sys.stderr)
+        return 2
+    if args.slo:
+        from apex_tpu.monitor.slo import SLOTracker, parse_slo_specs
+
+        slo_kw = {}
+        if args.slo_window:
+            short, _, long_ = args.slo_window.partition(":")
+            try:
+                slo_kw = {"short_window_s": float(short),
+                          "long_window_s": float(long_)}
+            except ValueError:
+                print(f"apex-tpu-serve: --slo-window {args.slo_window!r}: "
+                      f"want SHORT:LONG seconds (e.g. 30:150)",
+                      file=sys.stderr)
+                return 2
+        try:
+            slo = SLOTracker(parse_slo_specs(args.slo, **slo_kw))
+        except ValueError as e:
+            print(f"apex-tpu-serve: {e}", file=sys.stderr)
+            return 2
+
+    # live metrics: any of the three flags arms the per-tenant registry.
+    # The pull endpoint binds BEFORE the engine pays for params +
+    # compiles — an unbindable port is a usage error that must fail in
+    # milliseconds with exit 2, not a raw traceback after trace time
+    metrics = exporter = metrics_meta = None
+    if (args.metrics_port is not None or args.metrics_snapshot
+            or slo is not None):
+        from apex_tpu.serve.metrics import ServeMetrics
+        from apex_tpu.utils.env import capture_provenance
+
+        metrics = ServeMetrics(slo=slo)
+        # provenance rides the snapshot meta (same as apex-tpu-bench):
+        # check_regression's device-mismatch guard reads it, so a
+        # CPU-smoke serve snapshot can never silently gate real-chip
+        # numbers
+        metrics_meta = capture_provenance()
+        if args.metrics_port is not None:
+            from apex_tpu.monitor.export import MetricsExporter
+
+            try:
+                exporter = MetricsExporter(
+                    metrics.registry, port=args.metrics_port,
+                    snapshot_path=args.metrics_snapshot,
+                    meta=metrics_meta).start()
+            except OSError as e:
+                print(f"apex-tpu-serve: cannot bind --metrics-port "
+                      f"{args.metrics_port}: {e}", file=sys.stderr)
+                return 2
+            print(f"apex-tpu-serve: metrics at {exporter.url}",
+                  file=sys.stderr)
 
     try:
         engine = Engine(
@@ -229,12 +335,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         journal = TickJournal()
     sched = ServeScheduler(engine, tracer=tracer, flight_recorder=flight,
                            memory_accountant=mem, admission=admission,
-                           journal=journal)
+                           journal=journal, metrics=metrics)
     for i, toks in enumerate(prompts):
+        # --tenants with --stdin already exited 2 above
+        tenant = f"tenant-{i % args.tenants}" if args.tenants > 0 else None
         sched.submit(Request(request_id=f"req-{i}", tokens=toks,
                              max_new_tokens=args.max_new_tokens,
                              eos_id=args.eos_id,
-                             deadline_ms=args.deadline_ms))
+                             deadline_ms=args.deadline_ms,
+                             tenant=tenant))
     try:
         if journal is not None:
             from apex_tpu.serve.resilience import ServeSupervisor
@@ -244,6 +353,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             stats = sched.run()
     finally:
+        if exporter is not None:
+            # stop() also commits the atomic snapshot file when
+            # --metrics-snapshot rode along with the port
+            exporter.stop()
+        elif metrics is not None and args.metrics_snapshot:
+            from apex_tpu.monitor.export import write_snapshot
+
+            write_snapshot(metrics.registry, args.metrics_snapshot,
+                           meta=metrics_meta)
         if flight is not None:
             flight.detach()
         if tel is not None:
@@ -251,10 +369,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     for rec in stats.requests:
         print(json.dumps(rec, sort_keys=True))
-    print(json.dumps({"summary": stats.summary(),
-                      "decode_compiles": engine.decode_traces,
-                      "prefill_compiles": engine.prefill_traces},
-                     sort_keys=True))
+    final = {"summary": stats.summary(),
+             "decode_compiles": engine.decode_traces,
+             "prefill_compiles": engine.prefill_traces}
+    if metrics is not None:
+        # live totals + SLO state ride the same final line the exact
+        # summary does: the two views must reconcile (tier-1 asserts)
+        final["metrics"] = metrics.summary()
+    print(json.dumps(final, sort_keys=True))
     return 0
 
 

@@ -80,6 +80,7 @@ from typing import Any, Deque, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from apex_tpu.monitor.export import percentile
 from apex_tpu.serve.engine import Engine
 from apex_tpu.utils.logging import publish_event
 
@@ -102,6 +103,10 @@ class Request:
     # queued-but-never-admitted request times out against it too
     deadline_ms: Optional[float] = None
     priority: int = 0         # higher wins under the "priority" shed policy
+    # optional tenant label for per-tenant accounting (ServeMetrics):
+    # admission/latency/SLO series are recorded per tenant with bounded
+    # cardinality; None lands under the "default" tenant
+    tenant: Optional[str] = None
 
     # filled in by the scheduler
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -143,6 +148,8 @@ class Request:
             "new_tokens": len(self.generated),
             "generated": list(self.generated),
         }
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
         if self.state == "rejected":
             # load shedding is a server condition, not a request defect —
             # the CLI surfaces the retriable status so clients back off
@@ -174,16 +181,13 @@ class ServeStats:
     peak_resident_tokens: int = 0  # max cache tokens live at once
 
     def summary(self) -> Dict[str, Any]:
-        lat = sorted(self.decode_step_s)
-
-        def pct(p: float) -> float:
-            if not lat:
-                return 0.0
-            i = min(len(lat) - 1, int(round(p * (len(lat) - 1))))
-            return lat[i]
-
-        ttfts = sorted(r["ttft_s"] for r in self.requests
-                       if "ttft_s" in r)
+        # ONE percentile rule for every field: the exact nearest-rank
+        # helper shared with the histogram-quantile tests (the seed used
+        # len//2 indexing for TTFT but round-half-even linear indexing
+        # for the step fields — two answers for "the median");
+        # percentile() sorts internally, nothing here needs order
+        lat = list(self.decode_step_s)
+        ttfts = [r["ttft_s"] for r in self.requests if "ttft_s" in r]
         decode_s = sum(lat)
         rejected = sum(r["state"] == "rejected" for r in self.requests)
         return {
@@ -219,10 +223,13 @@ class ServeStats:
             # not the run's admission pattern
             "tokens_per_s": round(
                 self.decode_tokens / decode_s, 3) if decode_s else 0.0,
-            "p50_step_ms": round(pct(0.50) * 1e3, 3),
-            "p99_step_ms": round(pct(0.99) * 1e3, 3),
-            "ttft_p50_ms": round(
-                (ttfts[len(ttfts) // 2] if ttfts else 0.0) * 1e3, 3),
+            "p50_step_ms": round(percentile(lat, 0.50) * 1e3, 3),
+            "p99_step_ms": round(percentile(lat, 0.99) * 1e3, 3),
+            "ttft_p50_ms": round(percentile(ttfts, 0.50) * 1e3, 3),
+            # the tail the ttft_p99_ms SLO objective watches live — the
+            # exact end-of-run value is the oracle the histogram estimate
+            # is held against in tier-1
+            "ttft_p99_ms": round(percentile(ttfts, 0.99) * 1e3, 3),
             "wall_s": round(self.wall_s, 6),
         }
 
@@ -249,7 +256,7 @@ class ServeScheduler:
 
     def __init__(self, engine: Engine, *, fault_injector=None,
                  tracer=None, flight_recorder=None, memory_accountant=None,
-                 admission=None, journal=None):
+                 admission=None, journal=None, metrics=None):
         self.engine = engine
         self.injector = fault_injector
         self.admission = admission
@@ -260,6 +267,11 @@ class ServeScheduler:
             else None
         self.flight = flight_recorder
         self.memory = memory_accountant
+        # live per-tenant accounting + SLO evaluation (serve.metrics
+        # ServeMetrics): hooks fire at the same points the bus events
+        # publish, all host-side — decode still compiles exactly once
+        # with metrics armed (tier-1 scrapes a live loop and asserts)
+        self.metrics = metrics
         self._req_spans: Dict[Request, Dict[str, Any]] = {}
         self._sched_span = None    # root of the scheduler's tick trace
         # submit()/abort() are documented entry points for OTHER threads
@@ -304,6 +316,11 @@ class ServeScheduler:
         req.submit_t = time.perf_counter()
         req.state = "queued"
         with self._lock:
+            if self.metrics is not None:
+                # counted BEFORE the admission verdict: shed_frac is
+                # rejected over everything that ASKED, so a
+                # reject-at-submit must land in the submitted total too
+                self.metrics.on_submit(req)
             if self.admission is not None:
                 verdict, victim = self.admission.on_submit(self.queue, req)
                 if verdict == "reject":
@@ -346,6 +363,8 @@ class ServeScheduler:
         req.done_t = time.perf_counter()
         self.done.append(req)
         self._close_trace(req, "reject", reason)
+        if self.metrics is not None:
+            self.metrics.on_reject(req, reason)
         publish_event("serve_request_rejected", level="warning",
                       request_id=req.request_id, reason=reason,
                       retriable=True, seconds=round(seconds, 6),
@@ -418,6 +437,8 @@ class ServeScheduler:
             publish_event("serve_request_admitted",
                           request_id=req.request_id, slot=slot,
                           queue_wait_s=round(wait, 6))
+            if self.metrics is not None:
+                self.metrics.on_admit(req, wait)
             sp = self._req_spans.get(req)
             if sp is not None:
                 self.tracer.end(sp["queue"], t1=now,
@@ -440,6 +461,8 @@ class ServeScheduler:
                               hit_tokens=hit["hit_tokens"],
                               hit_pages=hit["hit_pages"],
                               scanned_tokens=hit["scanned"])
+                if self.metrics is not None:
+                    self.metrics.on_prefix_hit(req, hit["hit_tokens"])
             req.first_token_t = t_first
             sp = self._req_spans.get(req)
             if sp is not None:
@@ -519,6 +542,8 @@ class ServeScheduler:
         self.done.append(req)
         self._release(req)
         self._close_trace(req, "complete", reason)
+        if self.metrics is not None:
+            self.metrics.on_complete(req)
         publish_event("serve_request_completed",
                       request_id=req.request_id, slot=req.slot,
                       new_tokens=len(req.generated), finish_reason=reason,
@@ -602,6 +627,8 @@ class ServeScheduler:
         self.done.append(req)
         self._release(req)
         self._close_trace(req, "deadline", "deadline")
+        if self.metrics is not None:
+            self.metrics.on_deadline(req)
         # the whole submit-to-expiry span is lost serving time: the
         # client gave up, whatever was computed is discarded
         publish_event("serve_deadline_exceeded", level="warning",
@@ -620,6 +647,8 @@ class ServeScheduler:
         self._release(req)
         self._close_trace(req, "abort" if reason == "aborted" else "evict",
                           reason)
+        if self.metrics is not None:
+            self.metrics.on_evict(req, reason)
         publish_event("serve_request_evicted", level="warning",
                       request_id=req.request_id, slot=req.slot,
                       reason=reason)
@@ -676,6 +705,11 @@ class ServeScheduler:
                 # head's page probe keeps failing, and no decode step
                 # ever advances decode_steps toward max_steps
                 self._flush_evictions()
+                # idle ticks still move the occupancy gauges and the SLO
+                # windows: a deadline storm expiring queued-only requests
+                # must be able to breach (and later recover) with zero
+                # decode steps run
+                self._metrics_tick(None, 0)
                 if self.journal is not None:
                     self._journal_tick()
                 return bool(self.queue)
@@ -715,12 +749,30 @@ class ServeScheduler:
                 if req is not None:
                     self._accept_token(req, int(next_tokens[slot]))
             self._flush_evictions()
+            # AFTER the accept loop: completions landing on this tick
+            # feed the SLO windows before this tick's evaluate() — a
+            # breach crossed by the final tick's events must publish
+            # before run() exits, and the exit snapshot's burn gauges
+            # must reflect this tick, not the previous one
+            self._metrics_tick(dt, int(active.sum()))
             if self.journal is not None:
                 # end-of-tick: the state is consistent again — this is
                 # the snapshot a crash in the NEXT tick rolls back to
                 self._journal_tick()
             return any(r is not None
                        for r in self.slots) or bool(self.queue)
+
+    def _metrics_tick(self, dt_s: Optional[float], active: int) -> None:
+        """Feed the live-metrics layer one tick: the decode-step sample
+        (None on idle ticks), occupancy gauges, and the SLO evaluation —
+        all host-side, nothing touches the device."""
+        # caller holds self._lock (step())
+        if self.metrics is None:
+            return
+        self.metrics.on_tick(
+            dt_s=dt_s, active=active, queue_depth=len(self.queue),
+            resident_tokens=self.engine.resident_tokens,
+            free_page_frac=self.engine.free_page_frac)
 
     # --------------------------------------------- journal / warm restart
     def _journal_tick(self) -> None:
@@ -935,6 +987,11 @@ class ServeScheduler:
                             self.queue.remove(req)
                         self._evict(req, "shutdown")
                     self._flush_evictions()
+                    # the shutdown drain's evictions observed SLO events
+                    # with no tick left to evaluate them — one final
+                    # tick keeps the exit snapshot's gauges and breach
+                    # state current with everything above
+                    self._metrics_tick(None, 0)
         finally:
             if self.tracer is not None and self._sched_span is not None:
                 self.tracer.end(self._sched_span,

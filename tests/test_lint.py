@@ -138,6 +138,62 @@ def test_apx001_quiet_on_pure_traced_code_and_host_only_effects(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx001_flags_metrics_registry_record_in_traced_code(tmp_path):
+    """A live-metrics registry mutation reachable from a traced root is
+    the silently-wrong-telemetry class: it fires once per TRACE, not per
+    step. ``.record()``/``.observe()``/``.inc()`` are all flagged."""
+    _fixture(tmp_path, "apex_tpu/metered.py", """\
+        import jax
+        from apex_tpu.monitor.export import MetricsRegistry
+
+        REG = MetricsRegistry()
+        HIST = REG.histogram("step_seconds", "t")
+        STEPS = REG.counter("steps_total", "n")
+
+        def account(dt):
+            HIST.record(dt)
+            STEPS.inc()
+
+        @jax.jit
+        def step(x, dt):
+            account(dt)
+            return x + 1
+        """)
+    active, _ = _run(tmp_path, "APX001")
+    msgs = [v.message for v in active]
+    assert len(active) == 2
+    assert any(".record()" in m and "metrics sink" in m for m in msgs)
+    assert any(".inc()" in m for m in msgs)
+    assert all("step[@jit]" in m for m in msgs)
+
+
+def test_apx001_quiet_on_host_side_metrics_wiring(tmp_path):
+    """The real wiring — recording around the jitted call, the scheduler
+    tick hook pattern — stays quiet (the repo-wide clean run covers the
+    actual serve/metrics.py spelling)."""
+    _fixture(tmp_path, "apex_tpu/metered.py", """\
+        import time
+        import jax
+        from apex_tpu.monitor.export import MetricsRegistry
+
+        REG = MetricsRegistry()
+        HIST = REG.histogram("step_seconds", "t")
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def host_loop(xs):
+            for x in xs:
+                t0 = time.perf_counter()
+                y = step(x)
+                HIST.record(time.perf_counter() - t0)
+            return y
+        """)
+    active, _ = _run(tmp_path, "APX001")
+    assert not active, [v.format() for v in active]
+
+
 def test_apx001_boundary_functions_end_the_traversal(tmp_path):
     _fixture(tmp_path, "apex_tpu/tuned.py", """\
         import jax

@@ -25,7 +25,10 @@ Any function reachable from a root may not perform a **host effect**:
   config and is not flagged),
 - callback escapes (``io_callback``/``pure_callback``/
   ``jax.debug.print``/``jax.debug.callback`` — the "no-callback jaxpr"
-  invariant itself).
+  invariant itself),
+- live-metrics mutations (``.record()``/``.observe()``/``.inc()`` — a
+  monitor.export registry sample taken inside traced code lands once per
+  trace, not per step; record around the jitted call).
 
 The traversal stops at *sanctioned trace-time boundaries* — functions
 whose whole purpose is host-side static resolution during trace
@@ -58,6 +61,14 @@ EFFECT_NAME_CALLS = frozenset({
     "io_callback", "pure_callback",
 })
 EFFECT_ATTR_CALLS = frozenset({"item", "io_callback", "pure_callback"})
+# live-metrics mutation verbs (monitor.export registry: Counter.inc,
+# Histogram.record/observe). Inside traced code these fire once per
+# TRACE, not per step — the same silently-wrong-telemetry class as
+# publish_event. ``.set`` is deliberately absent: ``x.at[i].set(v)`` is
+# the jnp functional-update idiom all over legitimately traced code
+# (its subscripted chain never resolves here, but the name must not
+# invite the confusion either).
+METRIC_ATTR_CALLS = frozenset({"record", "observe", "inc"})
 TRACE_WRAPPERS = ("jit", "pallas_call", "shard_map")
 
 
@@ -339,6 +350,12 @@ class _Indexer:
         elif chain[-1] in EFFECT_ATTR_CALLS:
             info.effects.append(
                 (node.lineno, f".{chain[-1]}() is a host effect"))
+        elif chain[-1] in METRIC_ATTR_CALLS:
+            info.effects.append(
+                (node.lineno,
+                 f".{chain[-1]}() mutates a host-side metrics sink "
+                 f"(fires once per trace, not per step — record around "
+                 f"the jitted call, never inside it)"))
         elif "debug" in chain[:-1] and \
                 chain[-1] in ("print", "callback", "breakpoint"):
             info.effects.append(

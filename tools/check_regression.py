@@ -26,7 +26,17 @@ gate CI; real-chip numbers are checked in from bench runs
   rows are ignored;
 - a **bench suite JSON** (``BENCH_SUITE.json`` / ``BENCH_*.json`` shape):
   each sub-bench contributes its headline ``value`` (named by the entry
-  key) plus numeric detail fields as ``<entry>.<field>``.
+  key) plus numeric detail fields as ``<entry>.<field>``;
+- a **metrics snapshot** (``schema: "apex_tpu.metrics/v1"`` — from
+  ``--metrics-snapshot``, a ``/metrics.json`` scrape, or a
+  ``tools/metrics_merge.py`` fleet merge): counter families contribute
+  their cross-series totals, seconds-valued histograms contribute
+  nearest-rank ``<name>_p50_ms``/``<name>_p99_ms`` quantiles computed
+  over the merged buckets with the snapshot's own bucket geometry, and
+  the derived failure fractions ``shed_frac``/``deadline_miss_frac``
+  gate lower-is-better — so the serve bench and a live scrape produce
+  comparably gateable artifacts. Gauges are skipped (a point-in-time
+  level at whatever instant the snapshot was cut is not a perf claim).
 
 Only metrics present on BOTH sides are compared (each skip is reported).
 Direction is inferred from the name/unit: ``*_ms``/``*_s``/unit ``ms`` are
@@ -68,7 +78,7 @@ _LOWER_SUFFIXES = ("_ms", "_s", "_latency")
 # requests is strictly worse — without the hint "rejected" would default
 # to higher-is-better and a shedding regression would gate as a win.
 _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
-                "shed_rate", "rejected", "deadline_exceeded")
+                "shed_rate", "rejected", "deadline_exceeded", "evicted")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated
@@ -76,6 +86,11 @@ _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
 _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
                  "vs_baseline", "goodput", "imgs", "tokens", "seqs",
                  "hit_rate")
+# failure fractions beat the generic "_frac" higher family (the mirror
+# of the hit_rate-vs-_rate precedent): a snapshot's shed_frac or
+# deadline_miss_frac going UP is strictly worse — without the override
+# "_frac" would gate more shedding as a win
+_LOWER_OVERRIDES = ("shed_frac", "miss_frac", "fail_frac")
 
 
 def lower_is_better(name: str, unit: Optional[str] = None) -> bool:
@@ -84,6 +99,8 @@ def lower_is_better(name: str, unit: Optional[str] = None) -> bool:
     ``p50_ms``/``p99_ms``/``ttft_ms`` detail latencies are lower-is-better.
     """
     lname = name.lower()
+    if any(h in lname for h in _LOWER_OVERRIDES):
+        return True
     if unit and ("per_s" in unit or unit.endswith("/s")):
         return False
     if any(h in lname for h in _HIGHER_HINTS):
@@ -116,6 +133,94 @@ def metrics_from_jsonl(lines: List[dict], warmup: int) -> Dict[str, Tuple[float,
     return out
 
 
+METRICS_SNAPSHOT_SCHEMA = "apex_tpu.metrics/v1"
+
+_EXPORT_MOD = None
+
+
+def _export_module():
+    """Load ``apex_tpu/monitor/export.py`` by file path — the module is
+    stdlib-only at import time for exactly this kind of caller (the gate
+    must run on machines with no jax; importing the ``apex_tpu`` package
+    would pull it). Same pattern as ``tools/metrics_merge.py``, and the
+    reason there is exactly ONE copy of the nearest-rank quantile rule:
+    a second spelling here could silently diverge from the exporter's
+    own quantiles."""
+    global _EXPORT_MOD
+    if _EXPORT_MOD is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "apex_tpu", "monitor", "export.py")
+        spec = importlib.util.spec_from_file_location(
+            "_apex_tpu_metrics_export_gate", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _EXPORT_MOD = mod
+    return _EXPORT_MOD
+
+
+def _snapshot_quantile(buckets: Dict[int, int], count: int, p: float,
+                       lo: float, growth: float) -> float:
+    """Nearest-rank quantile over merged log-bucket counts, using the
+    SNAPSHOT'S own bucket geometry (never this tool's idea of it):
+    delegates to THE quantile rule in monitor.export."""
+    return _export_module().histogram_quantile(
+        buckets, count, p, lo=lo, growth=growth)
+
+
+def metrics_from_snapshot(doc: dict) -> Dict[str, Tuple[float, Optional[str]]]:
+    """Gateable metrics from an ``apex_tpu.metrics/v1`` snapshot:
+    counter totals (summed across label series), histogram-derived
+    ``_p50_ms``/``_p99_ms`` quantiles for seconds-valued families, and
+    the derived ``shed_frac``/``deadline_miss_frac`` failure fractions.
+    Gauges are point-in-time levels, not perf claims — skipped."""
+    out: Dict[str, Tuple[float, Optional[str]]] = {}
+    counters: Dict[str, float] = {}
+    for name, fam in doc.get("metrics", {}).items():
+        if not isinstance(fam, dict):
+            continue
+        series = fam.get("series", [])
+        if fam.get("type") == "counter":
+            total = float(sum(s.get("value", 0.0) for s in series))
+            counters[name] = total
+            out[name] = (total, None)
+        elif fam.get("type") == "histogram":
+            # ONLY seconds-valued families (the repo's *_seconds naming
+            # contract) become _p50_ms/_p99_ms: scaling a token-count or
+            # batch-size distribution by 1e3 and gating it as a
+            # forced-lower-is-better latency would be silently wrong in
+            # both value and direction
+            if not name.endswith("_seconds"):
+                continue
+            buckets: Dict[int, int] = {}
+            count = 0
+            for s in series:
+                count += int(s.get("count", 0))
+                for idx, n in s.get("buckets", {}).items():
+                    buckets[int(idx)] = buckets.get(int(idx), 0) + int(n)
+            if not count:
+                continue
+            base = name
+            if base.startswith("serve_"):
+                base = base[len("serve_"):]
+            base = base[:-len("_seconds")]
+            lo = float(fam.get("lo", 1e-6))
+            growth = float(fam.get("growth", 2.0 ** 0.125))
+            for p, tag in ((0.50, "p50"), (0.99, "p99")):
+                q = _snapshot_quantile(buckets, count, p, lo, growth)
+                out[f"{base}_{tag}_ms"] = (q * 1e3, "ms")
+    submitted = counters.get("serve_requests_submitted_total", 0.0)
+    if submitted > 0:
+        out["shed_frac"] = (
+            counters.get("serve_requests_rejected_total", 0.0) / submitted,
+            None)
+        out["deadline_miss_frac"] = (
+            counters.get("serve_deadline_exceeded_total", 0.0) / submitted,
+            None)
+    return out
+
+
 def metrics_from_suite(suite: dict) -> Dict[str, Tuple[float, Optional[str]]]:
     out: Dict[str, Tuple[float, Optional[str]]] = {}
     for name, entry in suite.items():
@@ -140,6 +245,8 @@ def load_metrics(path: str, warmup: int) -> Dict[str, Tuple[float, Optional[str]
     try:
         doc = json.loads(text)
         if isinstance(doc, dict):
+            if doc.get("schema") == METRICS_SNAPSHOT_SCHEMA:
+                return metrics_from_snapshot(doc)
             # a one-row telemetry JSONL is also a single JSON dict —
             # disambiguate by shape (suite entries are dicts with "value")
             is_suite = any(isinstance(v, dict) and "value" in v
@@ -168,6 +275,13 @@ def capture_provenance(path: str) -> Dict[str, object]:
         return {}
     if not isinstance(doc, dict):
         return {}
+    if doc.get("schema") == METRICS_SNAPSHOT_SCHEMA:
+        # snapshots stamp provenance under "meta" (apex-tpu-bench passes
+        # capture_provenance() through), so the device-mismatch guard
+        # covers snapshot-vs-snapshot and snapshot-vs-suite gates too
+        doc = doc.get("meta") or {}
+        if not isinstance(doc, dict):
+            return {}
     return {k: doc[k] for k in ("device_kind", "interpret_mode", "chip",
                                 "backend", "git", "captured")
             if k in doc}

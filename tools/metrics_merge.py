@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Merge per-rank/per-run metrics snapshots into one fleet view.
+
+Every serving/training process writes one mergeable snapshot
+(``apex-tpu-serve --metrics-snapshot``, ``apex-tpu-bench --serve
+--metrics-snapshot``, or a scrape of ``/metrics.json``). Because every
+histogram everywhere shares the same fixed log-bucket boundaries
+(``apex_tpu/monitor/export.py``), folding N snapshots is **exact**:
+counters add, gauges combine by their declared aggregation, histogram
+buckets add — bit-identical to having recorded the union stream into one
+registry. This is the aggregation seam tensor-parallel serving ranks
+will merge through (ROADMAP item 1).
+
+Usage::
+
+    python tools/metrics_merge.py rank0.json rank1.json ... -o fleet.json
+    python tools/metrics_merge.py rank*.json --prometheus   # text to stdout
+
+Exit status: 0 merged, 2 usage error (missing file, not a snapshot,
+incompatible histogram geometry — merging incomparable captures would
+silently fabricate a fleet view, so it refuses loudly instead).
+
+This tool is **standalone**: it loads the export module by file path, so
+it runs on a machine with no jax installed (the fleet-aggregation box is
+rarely an accelerator host).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_export_module():
+    """Load ``apex_tpu/monitor/export.py`` WITHOUT importing the
+    ``apex_tpu`` package (whose __init__ pulls jax): the module is
+    deliberately stdlib-only at import time for exactly this caller."""
+    path = os.path.join(_REPO, "apex_tpu", "monitor", "export.py")
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_metrics_export", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge mergeable metrics snapshots into one fleet "
+                    "view (counters add, gauges combine by declared agg, "
+                    "histogram buckets add exactly)")
+    ap.add_argument("snapshots", nargs="+",
+                    help="per-rank/per-run snapshot JSON files")
+    ap.add_argument("-o", "--output", default=None,
+                    help="write the merged snapshot here (atomic .tmp + "
+                         "os.replace; default: stdout)")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="render the merged view as Prometheus text "
+                         "exposition instead of snapshot JSON")
+    args = ap.parse_args(argv)
+
+    export = load_export_module()
+    docs = []
+    for path in args.snapshots:
+        try:
+            with open(path) as f:
+                docs.append(json.load(f))
+        except OSError as e:
+            print(f"metrics_merge: cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 2
+        except ValueError as e:
+            print(f"metrics_merge: {path} is not JSON: {e}",
+                  file=sys.stderr)
+            return 2
+    try:
+        merged = export.merge_snapshots(docs)
+    except ValueError as e:
+        # wrong schema / type mismatch / histogram geometry mismatch:
+        # these snapshots are NOT mergeable and a fabricated fleet view
+        # would be worse than no view
+        print(f"metrics_merge: {e}", file=sys.stderr)
+        return 2
+    if args.prometheus:
+        text = export.snapshot_to_prometheus(merged)
+        if args.output:
+            export.atomic_write_text(args.output, text)
+        else:
+            sys.stdout.write(text)
+        return 0
+    if args.output:
+        export.atomic_write_json(args.output, merged)
+    else:
+        json.dump(merged, sys.stdout, sort_keys=True, indent=1,
+                  default=float)
+        sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
