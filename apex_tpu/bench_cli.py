@@ -30,6 +30,14 @@ workflow as the kernel gate (docs/serving.md). ``--page-size``/
 mixed-length multi-tenant workload the pool's
 ``resident_tokens_per_hbm_byte`` / ``prefix_hit_rate`` capacity claims
 are measured on (docs/serving.md "Paged KV pool and prefix caching").
+``--replicas N`` runs the same workload over N thread-backed engine
+replicas under the fleet controller (``--hedge-ms``/``--heartbeat-ms``
+shape routing): the entry gains the fleet resilience counters
+(``failovers``/``hedge_fired``/``replica_dead``/``migrations`` — all
+lower-is-better, a 0→N failover storm gates as a regression) and the
+workload provenance records replicas/hedge_ms/heartbeat_ms so fleet
+counters are never gated across incomparable configs
+(docs/serving.md "Fleet failover and draining").
 """
 
 from __future__ import annotations
@@ -270,7 +278,10 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  prefix_cache: bool = False,
                  metrics_port: "int | None" = None,
                  metrics_snapshot: "str | None" = None,
-                 tenants: int = 0) -> None:
+                 tenants: int = 0,
+                 replicas: int = 1,
+                 hedge_ms: "float | None" = None,
+                 heartbeat_ms: "float | None" = None) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -319,6 +330,29 @@ def _serve_bench(steps: int, num_slots: int = 4,
         plo, phi = _parse_prompt_lens(prompt_len)
     except ValueError as e:
         raise SystemExit(f"apex-tpu-bench: {e}")
+    # fleet flag matrix (PR-10 precedent: inert/contradictory flags are
+    # loud usage errors before any compile, never silent no-ops)
+    if replicas < 1:
+        raise SystemExit(f"apex-tpu-bench: --replicas {replicas} must "
+                         f"be >= 1")
+    if replicas == 1 and (hedge_ms is not None
+                          or heartbeat_ms is not None):
+        raise SystemExit(
+            "apex-tpu-bench: --hedge-ms/--heartbeat-ms are fleet "
+            "routing; they need --replicas >= 2 (one replica has "
+            "nowhere to hedge or fail over to)")
+    if heartbeat_ms is not None and heartbeat_ms <= 0:
+        # a falsy-coerced default would be a silent no-op of the exact
+        # class this matrix refuses
+        raise SystemExit(f"apex-tpu-bench: --heartbeat-ms "
+                         f"{heartbeat_ms:g} must be > 0")
+    if replicas > 1 and (metrics_port is not None or metrics_snapshot
+                         or tenants > 0):
+        raise SystemExit(
+            "apex-tpu-bench: the live-metrics flags wire ONE registry; "
+            "with --replicas >= 2 capture per-replica snapshots via "
+            "apex-tpu-serve --replicas --metrics-snapshot and fold "
+            "them with tools/metrics_merge.py")
     # live metrics: same wiring as apex-tpu-serve — registry + optional
     # pull endpoint on a daemon thread, atomic snapshot at exit; the
     # scrape-vs-bench comparability is the point (check_regression gates
@@ -360,16 +394,24 @@ def _serve_bench(steps: int, num_slots: int = 4,
         # workload (e.g. the 32-1024 mixed sweep) needs longer rope/wpe
         cfg = dataclasses.replace(cfg, n_positions=max_len)
     cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32)
+    params = init_gpt2_params(cfg)
     try:
-        engine = Engine(cfg, init_gpt2_params(cfg),
-                        EngineConfig(num_slots=num_slots, max_len=max_len,
-                                     temperature=0.0, page_size=page_size,
-                                     num_pages=num_pages,
-                                     prefix_cache=prefix_cache), seed=0)
+        # one param pytree shared by every replica (read-only): the
+        # fleet bit-exactness story needs identical weights everywhere
+        engines = [Engine(cfg, params,
+                          EngineConfig(num_slots=num_slots,
+                                       max_len=max_len,
+                                       temperature=0.0,
+                                       page_size=page_size,
+                                       num_pages=num_pages,
+                                       prefix_cache=prefix_cache),
+                          seed=0)
+                   for _ in range(replicas)]
     except ValueError as e:
         # bad pool geometry (page_size not dividing max_len, undersized
         # num_pages, ...) is a usage error, same as the prefix check below
         raise SystemExit(f"apex-tpu-bench: {e}")
+    engine = engines[0]
     if shared_prefix + phi >= max_len:
         raise SystemExit(
             f"apex-tpu-bench: --shared-prefix {shared_prefix} + "
@@ -386,29 +428,59 @@ def _serve_bench(steps: int, num_slots: int = 4,
         buckets.append(b)
         b *= 2
     buckets.append(top)
-    engine.aot_compile(buckets)
+    for e in engines:
+        e.aot_compile(buckets)
     rng = np.random.RandomState(0)
-    admission = None
-    if max_queue is not None:
+
+    def _admission():
+        if max_queue is None:
+            return None
         from apex_tpu.serve.resilience import AdmissionController
 
-        admission = AdmissionController(max_queue=max_queue,
-                                        shed_policy=shed_policy)
-    sched = ServeScheduler(engine, admission=admission, metrics=metrics)
+        return AdmissionController(max_queue=max_queue,
+                                   shed_policy=shed_policy)
+
     # enough requests to keep every slot busy and exercise backfill
-    n_requests = max(2 * num_slots, (steps * num_slots) // 8 + 1)
+    n_requests = max(2 * num_slots * replicas,
+                     (steps * num_slots) // 8 + 1)
     system = [int(t) for t in rng.randint(0, cfg.vocab_size,
                                           shared_prefix)]
+    specs = []
     for i in range(n_requests):
         plen = int(rng.randint(plo, phi + 1))
         tail = [int(t) for t in rng.randint(0, cfg.vocab_size, plen)]
-        sched.submit(Request(
+        specs.append(Request(
             request_id=f"bench-{i}", tokens=system + tail,
             max_new_tokens=8, deadline_ms=deadline_ms,
             tenant=f"tenant-{i % tenants}" if tenants > 0 else None))
+    fleet = None
+    if replicas > 1:
+        from apex_tpu.serve.fleet import EngineReplica, FleetController
+
+        # CPU-tolerant death budget (2s at the default interval): a
+        # fabricated death on a healthy bench fleet would stamp nonzero
+        # failovers/replica_dead into lower-is-better gated counters —
+        # flunking the regression gate off machine noise
+        fleet = FleetController(
+            [EngineReplica(f"r{i}", e, admission=_admission())
+             for i, e in enumerate(engines)],
+            heartbeat_ms=50.0 if heartbeat_ms is None else heartbeat_ms,
+            suspect_misses=20, dead_misses=40, hedge_ms=hedge_ms)
+        for spec in specs:
+            fleet.submit(spec)
+    else:
+        sched = ServeScheduler(engine, admission=_admission(),
+                               metrics=metrics)
+        for spec in specs:
+            sched.submit(spec)
     t0 = time.perf_counter()
     try:
-        stats = sched.run(max_steps=steps)
+        # the fleet runs the whole request set (its workload bound is
+        # n_requests, which --steps sized above); the liveness bound
+        # scales with it so a long-but-healthy run never trips a
+        # TimeoutError mid-bench
+        stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(specs))) \
+            if fleet is not None else sched.run(max_steps=steps)
         # measured BEFORE the finally teardown: exporter.stop() blocks on
         # the HTTP server's shutdown poll + thread join + snapshot I/O,
         # and bench_wall_s gates lower-is-better — teardown noise must
@@ -423,6 +495,19 @@ def _serve_bench(steps: int, num_slots: int = 4,
             write_snapshot(metrics.registry, metrics_snapshot,
                            meta=metrics_meta)
     s = stats.summary()
+    if fleet is not None:
+        # fleet-wide capacity/hit aggregates the single path reads off
+        # its one scheduler; summed over replicas here
+        peak_resident = sum(h.scheduler.peak_resident_tokens
+                            for h in fleet.handles)
+        kv_bytes = sum(h.engine.kv_cache_bytes for h in fleet.handles)
+        admitted = sum(h.scheduler.admitted for h in fleet.handles)
+        prefix_hits = sum(h.scheduler.prefix_hits for h in fleet.handles)
+        s["prefix_hit_rate"] = round(prefix_hits / admitted, 4) \
+            if admitted else 0.0
+        s["peak_resident_tokens"] = peak_resident
+    else:
+        kv_bytes = engine.kv_cache_bytes
     suite = {
         "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
         # capture provenance: a CPU-smoke capture must be identifiable as
@@ -448,8 +533,17 @@ def _serve_bench(steps: int, num_slots: int = 4,
             # pool puts this gate metric near 1e-8, where round(x, 9)
             # would quantize away a real 5-10% capacity regression
             "resident_tokens_per_hbm_byte": float(
-                f"{s['peak_resident_tokens'] / max(engine.kv_cache_bytes, 1):.6g}"),
+                f"{s['peak_resident_tokens'] / max(kv_bytes, 1):.6g}"),
             "prefix_hit_rate": s["prefix_hit_rate"],
+            # fleet resilience counters (lower-is-better; the gate
+            # knows failover/hedge_fired/replica_dead) — only stamped
+            # by fleet captures, so single-replica baselines simply
+            # skip them instead of gating a missing field
+            **({"failovers": s["failovers"],
+                "hedge_fired": s["hedge_fired"],
+                "replica_dead": s["replica_dead"],
+                "migrations": s["migrations"]}
+               if fleet is not None else {}),
             "bench_wall_s": round(wall, 3),
             # workload config nested as a dict: check_regression lifts
             # only numeric scalars, so a capture with different
@@ -475,7 +569,14 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          "prefix_cache": bool(prefix_cache),
                          "prompt_len": prompt_len,
                          "shared_prefix": shared_prefix,
-                         "kv_cache_bytes": engine.kv_cache_bytes},
+                         "kv_cache_bytes": kv_bytes,
+                         # fleet shape provenance: counters shaped by a
+                         # different replica count / hedge / heartbeat
+                         # config are identifiable, never silently
+                         # gated across incomparable configs
+                         "replicas": replicas,
+                         "hedge_ms": hedge_ms,
+                         "heartbeat_ms": heartbeat_ms},
             # a subset capture, not the full committed suite
             "complete": False,
         },
@@ -621,6 +722,17 @@ def main() -> None:
                             help="label the scripted workload round-"
                                  "robin across N tenants (per-tenant "
                                  "series in the live metrics)")
+            ap.add_argument("--replicas", type=int, default=1,
+                            help="run the workload over N thread-backed "
+                                 "engine replicas under the fleet "
+                                 "controller; the entry gains failovers/"
+                                 "hedge_fired/replica_dead/migrations")
+            ap.add_argument("--hedge-ms", type=float, default=None,
+                            help="hedged dispatch threshold (needs "
+                                 "--replicas >= 2)")
+            ap.add_argument("--heartbeat-ms", type=float, default=None,
+                            help="replica heartbeat interval (needs "
+                                 "--replicas >= 2; default 50)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -635,7 +747,10 @@ def main() -> None:
                          prefix_cache=args.prefix_cache,
                          metrics_port=args.metrics_port,
                          metrics_snapshot=args.metrics_snapshot,
-                         tenants=args.tenants)
+                         tenants=args.tenants,
+                         replicas=args.replicas,
+                         hedge_ms=args.hedge_ms,
+                         heartbeat_ms=args.heartbeat_ms)
         elif has_telemetry:
             import argparse
 

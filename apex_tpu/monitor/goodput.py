@@ -58,6 +58,12 @@ STALL_EVENTS = {
     # capacity attributable to KV bytes, distinct from serve_queue_wait
     # (slot scarcity); the two overlap in wall time by design
     "serve_page_alloc_fail": "serve_page_alloc_fail",
+    # serving fleet (PR 11): a request was re-dispatched off a dead (or
+    # draining) replica — ``seconds`` is the span it had already spent
+    # on that replica: the prefill/decode work a survivor redoes
+    # (bit-identically under greedy decoding), plus the queue time the
+    # migration wasted. Overlaps other serving causes by design.
+    "serve_failover": "serve_failover",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
@@ -80,6 +86,15 @@ COUNTED_EVENTS = (
     # rate crossed the breach condition / dropped back under it — one
     # event per transition, never one per tick
     "serve_slo_breach", "serve_slo_recovered",
+    # serving fleet (serve.fleet): heartbeat-driven replica health
+    # transitions (suspect at suspect_misses silent intervals, dead at
+    # dead_misses — exactly one event per transition, dead is
+    # absorbing), one hedged dispatch fired after hedge_ms with no
+    # terminal status, and the rolling-restart lifecycle (drained when
+    # the last in-flight request leaves, restarted on rejoin)
+    "serve_replica_suspect", "serve_replica_dead",
+    "serve_hedge_fired",
+    "serve_replica_drained", "serve_replica_restarted",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

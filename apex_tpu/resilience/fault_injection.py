@@ -34,6 +34,19 @@ Production failure modes, reproduced on a laptop with a seed:
   admission-control/load-shedding workload). The tier-1 chaos suite runs
   all three under one schedule and asserts every submitted request
   reaches exactly one terminal status.
+- **Fleet chaos** — replica-level failures for the serving fleet
+  (:mod:`apex_tpu.serve.fleet`): ``kill_replica(rid, at_tick)`` raises
+  :class:`SimulatedCrash` inside the replica's worker loop (the process
+  is gone — heartbeats stop, the registry sweep escalates, the router
+  re-dispatches), ``partition_replica(rid, at_tick, ticks)`` drops the
+  replica's heartbeats AND result channel for a tick window while it
+  keeps decoding (the router must not double-complete when the
+  partition heals — ``heal_replica`` ends the window), and
+  ``straggler_replica(rid, delay_s, at_tick, ticks)`` stalls each of
+  its ticks (what drives hedged dispatch deterministically). The tier-1
+  fleet smoke runs kill + partition + straggler in one seeded schedule
+  and asserts every submitted request reaches exactly one terminal
+  status fleet-wide.
 - **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
   window of steps whose gradients ``poison_grads`` fills with NaN/Inf
   (choice seeded), reproducing the overflow storms that collapse a dynamic
@@ -130,6 +143,10 @@ class FaultInjector:
         self._latency_spikes: Dict[int, float] = {}    # step -> seconds
         self._storms: Dict[int, List[Dict[str, Any]]] = {}  # step -> specs
         self._storm_serial = 0
+        # fleet chaos: replica id -> schedule (worker-loop tick units)
+        self._replica_kills: Dict[str, int] = {}
+        self._partitions: Dict[str, List[int]] = {}    # [start, end)
+        self._replica_straggles: Dict[str, List[float]] = {}
 
     # ---- filesystem faults ---------------------------------------------
     def filesystem(self) -> Filesystem:
@@ -327,6 +344,67 @@ class FaultInjector:
         """Request-constructor kwargs for the burst scheduled before
         decode step ``step`` (consumed)."""
         return self._storms.pop(int(step), [])
+
+    # ---- serving fleet: replica-level chaos -----------------------------
+    def kill_replica(self, replica_id: Any,
+                     at_tick: int = 1) -> "FaultInjector":
+        """Kill a fleet replica's worker at its ``at_tick``-th loop tick:
+        :class:`SimulatedCrash` inside the worker — heartbeats stop, the
+        registry sweep escalates suspect → dead, and the router fails
+        the replica's live requests over to survivors. One-shot."""
+        self._replica_kills[str(replica_id)] = int(at_tick)
+        return self
+
+    def replica_kill_due(self, replica_id: Any, tick: int) -> bool:
+        """Consumed by the replica worker loop each tick."""
+        at = self._replica_kills.get(str(replica_id))
+        if at is not None and tick >= at:
+            del self._replica_kills[str(replica_id)]
+            return True
+        return False
+
+    def partition_replica(self, replica_id: Any, at_tick: int = 1,
+                          ticks: int = 10**9) -> "FaultInjector":
+        """Network-partition a replica for a window of worker-loop
+        ticks: heartbeats are dropped AND results stop crossing to the
+        router, but the replica keeps decoding — the router declares it
+        dead and re-dispatches, and when the partition heals (the window
+        ends, or :meth:`heal_replica`) its duplicate completions must
+        lose the first-terminal-wins race, never double-complete."""
+        self._partitions[str(replica_id)] = [int(at_tick),
+                                             int(at_tick) + int(ticks)]
+        return self
+
+    def replica_partitioned(self, replica_id: Any, tick: int) -> bool:
+        """Window check (NOT consumed) — the worker evaluates it every
+        tick so the partition ends exactly when the window does."""
+        win = self._partitions.get(str(replica_id))
+        return bool(win and win[0] <= tick < win[1])
+
+    def heal_replica(self, replica_id: Any) -> "FaultInjector":
+        """End a replica's partition window now (the heal the
+        no-double-complete test drives explicitly)."""
+        self._partitions.pop(str(replica_id), None)
+        return self
+
+    def straggler_replica(self, replica_id: Any, delay_s: float,
+                          at_tick: int = 1,
+                          ticks: int = 1) -> "FaultInjector":
+        """Stall each of a replica's worker ticks in ``[at_tick,
+        at_tick + ticks)`` by ``delay_s`` — a slow host/device that is
+        alive but late: the deterministic way to make the router's
+        hedged dispatch fire."""
+        self._replica_straggles[str(replica_id)] = [
+            float(at_tick), float(at_tick) + float(ticks),
+            float(delay_s)]
+        return self
+
+    def replica_straggle_due(self, replica_id: Any, tick: int) -> float:
+        """Seconds this replica's worker should stall this tick."""
+        ent = self._replica_straggles.get(str(replica_id))
+        if ent and ent[0] <= tick < ent[1]:
+            return ent[2]
+        return 0.0
 
     # ---- preemption -----------------------------------------------------
     def fire_preemption(self, sig: int = signal.SIGTERM) -> None:

@@ -31,6 +31,13 @@ one invariant:
   rolls back to the last journaled tick; every submitted request reaches
   exactly one terminal status). Per-request deadlines live on
   :class:`Request` (``deadline_ms``) and are swept every tick.
+- :mod:`~apex_tpu.serve.fleet` — :class:`FleetController`: the control
+  plane above N engine replicas (thread-backed so CPU tier-1 fakes a
+  pod) — heartbeat replica health (:class:`ReplicaRegistry`),
+  least-loaded + burn-rate-aware routing with bounded retry and hedged
+  dispatch, failover re-dispatch off dead replicas (exactly-once
+  terminal status by request id), and drain/rolling restart that never
+  drops admitting capacity below N-1.
 - :mod:`~apex_tpu.serve.metrics` — :class:`ServeMetrics`: live per-tenant
   accounting (bounded-cardinality counters, TTFT/latency histograms,
   occupancy gauges) into an :class:`apex_tpu.monitor.export.MetricsRegistry`
@@ -44,6 +51,9 @@ overload/failure contracts.
 """
 
 from apex_tpu.serve.engine import Engine, EngineConfig  # noqa: F401
+from apex_tpu.serve.fleet import (EngineReplica,  # noqa: F401
+                                  FleetController, FleetStats,
+                                  ReplicaRegistry)
 from apex_tpu.serve.kv_cache import (KVCache, evict_slots,  # noqa: F401
                                      init_cache, write_token)
 from apex_tpu.serve.metrics import ServeMetrics  # noqa: F401
@@ -58,4 +68,5 @@ __all__ = [
     "evict_slots", "Request", "ServeScheduler", "ServeStats",
     "AdmissionController", "TickJournal", "ServeSupervisor",
     "SHED_POLICIES", "ServeMetrics",
+    "FleetController", "EngineReplica", "ReplicaRegistry", "FleetStats",
 ]

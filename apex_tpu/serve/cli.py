@@ -45,6 +45,24 @@ NAME=VALUE`` (repeatable) arms burn-rate tracked objectives whose
 breach/recovery transitions publish ``serve_slo_breach`` /
 ``serve_slo_recovered`` bus events.
 
+Serving fleet (docs/serving.md "Fleet failover and draining"):
+``--replicas N`` (N >= 2) runs N thread-backed engine replicas under a
+:class:`~apex_tpu.serve.fleet.FleetController` — heartbeat replica
+health (``--heartbeat-ms``), least-loaded routing with failover
+re-dispatch off dead replicas, optional hedged dispatch
+(``--hedge-ms``: a request with no terminal status after that long
+fires one copy on a second replica, first terminal wins), and
+``--drain-on SIGTERM`` (on SIGTERM: stop admitting, shed still-queued
+requests as retriable rejections — a healthy fleet can serve them —
+finish in-flight ones, exit cleanly). The summary gains ``failovers`` /
+``hedge_fired`` / ``migrations``; ``--metrics-snapshot PATH`` writes one
+mergeable snapshot PER replica (``PATH.rK``) plus the
+``tools/metrics_merge.py`` fleet view at ``PATH`` itself. Flags that
+wire a single scheduler (``--max-restarts``, ``--trace-jsonl``,
+``--flight-recorder``, ``--metrics-port``) are usage errors with
+``--replicas > 1``, as are the fleet knobs with ``--replicas 1`` —
+never silent no-ops.
+
 Example::
 
     apex-tpu-serve --config tiny --requests 4 --max-new-tokens 8 \
@@ -64,6 +82,139 @@ import numpy as np
 def _parse_line(line: str) -> List[int]:
     toks = line.replace(",", " ").split()
     return [int(t) for t in toks]
+
+
+def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
+    """The ``--replicas N`` path: N thread-backed engine replicas under
+    a :class:`~apex_tpu.serve.fleet.FleetController`. ``slo`` (one
+    parsed tracker, or None) donates its objective DECLARATIONS — each
+    replica gets its own tracker instance so burn windows never alias
+    across replicas (the burn is the per-replica routing signal)."""
+    import signal as signal_mod
+
+    from apex_tpu.serve.engine import (Engine, EngineConfig,
+                                       init_gpt2_params)
+    from apex_tpu.serve.fleet import EngineReplica, FleetController
+    from apex_tpu.serve.scheduler import Request
+
+    want_metrics = bool(args.metrics_snapshot) or slo is not None
+    metrics_meta = None
+    if want_metrics:
+        from apex_tpu.utils.env import capture_provenance
+
+        metrics_meta = capture_provenance()
+
+    params = init_gpt2_params(cfg, seed=args.seed)
+    handles = []
+    for i in range(args.replicas):
+        try:
+            engine = Engine(
+                cfg, params,
+                EngineConfig(num_slots=args.num_slots, max_len=max_len,
+                             temperature=args.temperature,
+                             top_k=args.top_k, page_size=args.page_size,
+                             num_pages=args.num_pages,
+                             prefix_cache=args.prefix_cache),
+                seed=args.seed)
+        except ValueError as e:
+            print(f"apex-tpu-serve: {e}", file=sys.stderr)
+            return 2
+        admission = metrics = None
+        if args.max_queue is not None:
+            from apex_tpu.serve.resilience import AdmissionController
+
+            admission = AdmissionController(max_queue=args.max_queue,
+                                            shed_policy=args.shed_policy)
+        if want_metrics:
+            from apex_tpu.monitor.slo import SLOTracker
+            from apex_tpu.serve.metrics import ServeMetrics
+
+            tracker = SLOTracker(slo.objectives) \
+                if slo is not None else None
+            metrics = ServeMetrics(slo=tracker)
+        handles.append(EngineReplica(f"r{i}", engine,
+                                     admission=admission,
+                                     metrics=metrics))
+    # ALWAYS pre-compile in fleet mode (--aot is implied): a prefill or
+    # decode compiling inside a worker's first tick blocks that
+    # replica's heartbeats for the whole trace time — seconds — which
+    # the registry can only read as a death, triggering a spurious
+    # fleet-wide failover before any request is served. Startup pays
+    # every trace; the heartbeat window only ever measures serving.
+    # EVERY reachable pow2 bucket is warmed, not just the prompt
+    # lengths': a prefix-cache hit prefills only the unshared tail,
+    # which lands on a smaller bucket (the bench warms identically)
+    top = max(len(p) for p in prompts)
+    buckets, b = [], 1
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    buckets.append(top)
+    for h in handles:
+        h.engine.aot_compile(buckets)
+    tel = None
+    if args.telemetry_jsonl:
+        from apex_tpu.monitor import Telemetry
+
+        tel = Telemetry(args.telemetry_jsonl)
+    # CPU-tolerant death budget (heartbeat_ms * dead_misses = 2s at the
+    # default interval): the XLA CPU client serializes executions, so a
+    # contended decode tick — during which the worker cannot beat — can
+    # stall far past a tight window; fabricated deaths would duplicate
+    # work via failover on a perfectly healthy fleet. Operators trade
+    # detection latency via --heartbeat-ms (the budget scales with it).
+    fleet = FleetController(
+        handles,
+        heartbeat_ms=50.0 if args.heartbeat_ms is None
+        else args.heartbeat_ms,
+        suspect_misses=20, dead_misses=40, hedge_ms=args.hedge_ms)
+    if args.drain_on == "SIGTERM":
+        # stop admitting, shed the queued backlog retriable, finish
+        # in-flight, exit cleanly — the rolling-deployment contract
+        # (fleet.begin_drain is one flag write; safe at signal depth,
+        # the control thread's next pump does the shedding)
+        signal_mod.signal(signal_mod.SIGTERM,
+                          lambda *_: fleet.begin_drain())
+    for i, toks in enumerate(prompts):
+        tenant = f"tenant-{i % args.tenants}" if args.tenants > 0 else None
+        fleet.submit(Request(request_id=f"req-{i}", tokens=toks,
+                             max_new_tokens=args.max_new_tokens,
+                             eos_id=args.eos_id,
+                             deadline_ms=args.deadline_ms,
+                             tenant=tenant))
+    try:
+        # liveness bound scaled to the workload: a large --requests run
+        # is long, not wedged
+        stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(prompts)))
+    finally:
+        if want_metrics and args.metrics_snapshot:
+            # one mergeable snapshot PER replica (PATH.rK — what a real
+            # fleet's ranks each write) plus the metrics_merge fleet
+            # view at PATH itself, all atomic; provenance meta rides
+            # each so the device-mismatch gate still sees it
+            from apex_tpu.monitor.export import (atomic_write_json,
+                                                 merge_snapshots)
+
+            docs = []
+            for i, h in enumerate(handles):
+                doc = h.metrics.registry.snapshot(
+                    meta={**(metrics_meta or {}),
+                          "replica": h.replica_id})
+                atomic_write_json(f"{args.metrics_snapshot}.r{i}", doc)
+                docs.append(doc)
+            atomic_write_json(args.metrics_snapshot,
+                              merge_snapshots(docs))
+        if tel is not None:
+            tel.close()
+    for rec in stats.requests:
+        print(json.dumps(rec, sort_keys=True))
+    final = {"summary": stats.summary(),
+             "decode_compiles": [h.engine.decode_traces
+                                 for h in handles],
+             "prefill_compiles": [h.engine.prefill_traces
+                                  for h in handles]}
+    print(json.dumps(final, sort_keys=True))
+    return 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -143,6 +294,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--slo-window", default=None, metavar="SHORT:LONG",
                     help="burn-rate window spans in seconds "
                          "(default 60:300)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run N thread-backed engine replicas under the "
+                         "fleet controller (heartbeat health, failover "
+                         "re-dispatch, hedging; default 1 = the single "
+                         "scheduler path)")
+    ap.add_argument("--hedge-ms", type=float, default=None,
+                    help="hedged dispatch: a request with no terminal "
+                         "status after this many ms fires one copy on a "
+                         "second replica; first terminal wins, the loser "
+                         "is aborted (needs --replicas >= 2)")
+    ap.add_argument("--heartbeat-ms", type=float, default=None,
+                    help="replica heartbeat interval; a replica silent "
+                         "for 20 intervals is suspect, 40 is dead and "
+                         "its requests fail over (default 50 -> a 2s "
+                         "death budget, sized so a contended decode "
+                         "tick never reads as a death; needs "
+                         "--replicas >= 2)")
+    ap.add_argument("--drain-on", default=None, choices=["SIGTERM"],
+                    help="on this signal, stop admitting new work, shed "
+                         "still-queued requests as retriable "
+                         "rejections, and finish in-flight ones before "
+                         "exiting cleanly (needs --replicas >= 2)")
     ap.add_argument("--stdin", action="store_true",
                     help="read one token-id request per input line")
     ap.add_argument("--aot", action="store_true",
@@ -177,6 +350,51 @@ def main(argv: Optional[List[str]] = None) -> int:
     if max_len < args.max_len:
         print(f"apex-tpu-serve: --max-len {args.max_len} clamped to the "
               f"model's n_positions={max_len}", file=sys.stderr)
+
+    # fleet flag matrix, BEFORE any params/compile work: an inert or
+    # contradictory combination is a usage error that must fail in
+    # milliseconds (PR-10 precedent), never a silent no-op
+    if args.replicas < 1:
+        print(f"apex-tpu-serve: --replicas {args.replicas} must be >= 1",
+              file=sys.stderr)
+        return 2
+    if args.replicas == 1:
+        inert = [(args.hedge_ms is not None, "--hedge-ms"),
+                 (args.heartbeat_ms is not None, "--heartbeat-ms"),
+                 (args.drain_on is not None, "--drain-on")]
+        bad = [flag for cond, flag in inert if cond]
+        if bad:
+            print(f"apex-tpu-serve: {bad[0]} is fleet routing; it needs "
+                  f"--replicas >= 2 (one replica has nowhere to hedge, "
+                  f"fail over, or drain to)", file=sys.stderr)
+            return 2
+    else:
+        if args.heartbeat_ms is not None and args.heartbeat_ms <= 0:
+            # `or 50.0` would silently replace an explicit 0 with the
+            # default — the exact silent-no-op class this matrix exists
+            # to refuse
+            print(f"apex-tpu-serve: --heartbeat-ms "
+                  f"{args.heartbeat_ms:g} must be > 0", file=sys.stderr)
+            return 2
+        single_only = [
+            (args.max_restarts > 0, "--max-restarts",
+             "the per-replica warm-restart supervisor wires ONE "
+             "scheduler; the fleet recovers by failover re-dispatch"),
+            (args.trace_jsonl is not None, "--trace-jsonl",
+             "per-request span tracing is single-scheduler wiring"),
+            (args.flight_recorder is not None, "--flight-recorder",
+             "the recorder guards ServeScheduler.run(), which fleet "
+             "workers never call — it would be armed but inert"),
+            (args.metrics_port is not None, "--metrics-port",
+             "the pull endpoint serves ONE registry; fleet metrics are "
+             "per-replica snapshots folded by tools/metrics_merge.py"),
+        ]
+        for cond, flag, why in single_only:
+            if cond:
+                print(f"apex-tpu-serve: {flag} cannot apply with "
+                      f"--replicas {args.replicas}: {why}",
+                      file=sys.stderr)
+                return 2
 
     if args.tenants > 0 and args.stdin:
         # before the stdin read: stdin lines carry no tenant identity to
@@ -248,6 +466,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             print(f"apex-tpu-serve: {e}", file=sys.stderr)
             return 2
+
+    if args.replicas > 1:
+        # every usage check above already ran: the fleet path pays for
+        # params/compiles only once the request stream and SLO specs
+        # are known-good
+        return _run_fleet(args, cfg, max_len, prompts, slo)
 
     # live metrics: any of the three flags arms the per-tenant registry.
     # The pull endpoint binds BEFORE the engine pays for params +

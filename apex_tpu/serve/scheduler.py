@@ -569,6 +569,57 @@ class ServeScheduler:
             self.engine.evict(sorted(pending))
         self._to_evict.clear()
 
+    # ------------------------------------------------- fleet hooks
+    def load(self) -> int:
+        """Queued + in-slot requests — the fleet router's load signal
+        (and its drain-completion probe). Safe from any thread."""
+        with self._lock:
+            return len(self.queue) + sum(r is not None
+                                         for r in self.slots)
+
+    def done_since(self, cursor: int):
+        """Terminal requests appended to :attr:`done` since ``cursor``,
+        plus the new cursor — the fleet router's harvest hook. Read
+        under the scheduler lock; the returned :class:`Request` objects
+        are terminal and never mutate again, so the caller may inspect
+        them lock-free."""
+        with self._lock:
+            return list(self.done[cursor:]), len(self.done)
+
+    def pop_queued(self, request_id) -> Optional[Request]:
+        """Remove and return a still-queued request WITHOUT a terminal
+        status — the fleet drain/migrate hook: the request is about to
+        be re-submitted to another replica, so terminal-accounting it
+        here (the way :meth:`abort` does) would give it two records
+        fleet-wide. Its wasted queue time still lands on the ledger
+        (``serve_queue_wait`` — the wait was real whichever replica
+        finally serves it). Returns ``None`` when the request is not
+        queued (already admitted — the caller lets it finish in place —
+        or already terminal)."""
+        with self._lock:
+            req = self._remove_queued(request_id)
+            if req is not None:
+                self._close_trace(req, "evict", "migrated")
+            return req
+
+    def _remove_queued(self, request_id) -> Optional[Request]:
+        """Take a request out of the queue and publish its uncharged
+        wait — the ONE queue-exit bookkeeping (abort and pop_queued
+        share it, so migration accounting can never diverge from abort
+        accounting); the caller owns the terminal/trace handling."""
+        # caller holds self._lock (abort()/pop_queued())
+        for req in list(self.queue):
+            if req.request_id == request_id:
+                self.queue.remove(req)
+                self._stall_head_removed(req)
+                publish_event(
+                    "serve_queue_wait",
+                    seconds=max(time.perf_counter() - req.submit_t
+                                - req.wait_charged, 0.0),
+                    request_id=req.request_id)
+                return req
+        return None
+
     def abort(self, request_id) -> bool:
         """Mid-stream abort: evict a running request (or drop it from the
         queue). Other slots are untouched — bit-identical, by the static
@@ -583,17 +634,10 @@ class ServeScheduler:
         requests; before this, an aborted queued request's wait simply
         vanished from the ledger)."""
         with self._lock:
-            for req in list(self.queue):
-                if req.request_id == request_id:
-                    self.queue.remove(req)
-                    self._stall_head_removed(req)
-                    publish_event(
-                        "serve_queue_wait",
-                        seconds=max(time.perf_counter() - req.submit_t
-                                    - req.wait_charged, 0.0),
-                        request_id=req.request_id)
-                    self._evict(req, "aborted")
-                    return True
+            req = self._remove_queued(request_id)
+            if req is not None:
+                self._evict(req, "aborted")
+                return True
             for req in self.slots:
                 if req is not None and req.request_id == request_id:
                     self._evict(req, "aborted")

@@ -525,6 +525,84 @@ def test_apx005_covers_deadline_sweep_clocks(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx005_covers_fleet_heartbeat_deadline(tmp_path):
+    """PR-11 coverage proof: a fleet heartbeat-miss check computed from
+    ``time.time()`` deltas fires APX005 (an NTP step would declare every
+    replica dead at once and trigger a fleet-wide failover storm); the
+    monotonic spelling the real registry sweep uses stays quiet."""
+    _fixture(tmp_path, "apex_tpu/serve/fleet.py", """\
+        import time
+
+        def sweep(rows, heartbeat_s, dead_misses):
+            now = time.time()
+            return [rid for rid, row in rows.items()
+                    if (now - row["last_beat"]) / heartbeat_s
+                    >= dead_misses]
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert len(active) == 1 and "monotonic" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "serve" / "fleet.py"
+    good.write_text(textwrap.dedent("""\
+        import time
+
+        def sweep(rows, heartbeat_s, dead_misses):
+            now = time.perf_counter()
+            return [rid for rid, row in rows.items()
+                    if (now - row["last_beat"]) / heartbeat_s
+                    >= dead_misses]
+        """))
+    active, _ = _run(tmp_path, "APX005")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx002_covers_fleet_registry_heartbeat_thread(tmp_path):
+    """PR-11 coverage proof: the replica registry is mutated from every
+    replica's heartbeat thread — a lock-free read-modify-write of the
+    rows fires APX002 (two threads beating at once would lose beats and
+    fabricate a death); the real lock-disciplined spelling stays
+    quiet."""
+    _fixture(tmp_path, "apex_tpu/serve/fleet.py", """\
+        import threading
+
+        class ReplicaRegistry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = {}
+
+            def register(self, rid):
+                with self._lock:
+                    self._rows[rid] = {"beats": 0}
+
+            def heartbeat(self, rid, now):
+                # called from the replica's heartbeat thread — lock-free
+                self._rows[rid] = {"last_beat": now}
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 1
+    assert "lock-free" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "serve" / "fleet.py"
+    good.write_text(textwrap.dedent("""\
+        import threading
+
+        class ReplicaRegistry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = {}
+
+            def register(self, rid):
+                with self._lock:
+                    self._rows[rid] = {"beats": 0}
+
+            def heartbeat(self, rid, now):
+                with self._lock:
+                    self._rows[rid] = {"last_beat": now}
+        """))
+    active, _ = _run(tmp_path, "APX002")
+    assert not active, [v.format() for v in active]
+
+
 # --------------------------------------------------- 3. suppressions
 
 def test_justified_suppression_suppresses_and_is_counted(tmp_path):

@@ -77,8 +77,12 @@ _LOWER_SUFFIXES = ("_ms", "_s", "_latency")
 # Overload SLO counters are failure rates: more shed/rejected/expired
 # requests is strictly worse — without the hint "rejected" would default
 # to higher-is-better and a shedding regression would gate as a win.
+# Fleet resilience counters are the same family: a 0 -> N failover (or
+# hedge, or replica-death) storm in a capture is a regression the gate
+# must catch, never a win.
 _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
-                "shed_rate", "rejected", "deadline_exceeded", "evicted")
+                "shed_rate", "rejected", "deadline_exceeded", "evicted",
+                "failover", "hedge_fired", "replica_dead")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated
