@@ -37,7 +37,15 @@ shape routing): the entry gains the fleet resilience counters
 lower-is-better, a 0→N failover storm gates as a regression) and the
 workload provenance records replicas/hedge_ms/heartbeat_ms so fleet
 counters are never gated across incomparable configs
-(docs/serving.md "Fleet failover and draining").
+(docs/serving.md "Fleet failover and draining"). ``--trace-jsonl`` (+
+``--trace-sample``) arms cross-replica request journeys — the fleet
+trace at PATH, one Chrome-trace per replica at PATH.rK, seeded head
+sampling with tail capture — ``--flight-recorder`` arms per-replica
+postmortems, and the live-metrics flags serve/commit the merged fleet
+registry view; the entry stamps ``trace_promoted`` (lower-is-better)
+plus traced/trace_sample workload provenance so traced and untraced
+captures never gate against each other (docs/observability.md "Fleet
+request journeys").
 """
 
 from __future__ import annotations
@@ -281,7 +289,10 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  tenants: int = 0,
                  replicas: int = 1,
                  hedge_ms: "float | None" = None,
-                 heartbeat_ms: "float | None" = None) -> None:
+                 heartbeat_ms: "float | None" = None,
+                 trace_jsonl: "str | None" = None,
+                 trace_sample: "float | None" = None,
+                 flight_recorder: "str | None" = None) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -346,20 +357,26 @@ def _serve_bench(steps: int, num_slots: int = 4,
         # class this matrix refuses
         raise SystemExit(f"apex-tpu-bench: --heartbeat-ms "
                          f"{heartbeat_ms:g} must be > 0")
-    if replicas > 1 and (metrics_port is not None or metrics_snapshot
-                         or tenants > 0):
-        raise SystemExit(
-            "apex-tpu-bench: the live-metrics flags wire ONE registry; "
-            "with --replicas >= 2 capture per-replica snapshots via "
-            "apex-tpu-serve --replicas --metrics-snapshot and fold "
-            "them with tools/metrics_merge.py")
-    # live metrics: same wiring as apex-tpu-serve — registry + optional
-    # pull endpoint on a daemon thread, atomic snapshot at exit; the
-    # scrape-vs-bench comparability is the point (check_regression gates
-    # either artifact with the same direction hints). Armed BEFORE the
-    # engine pays for params + compiles: an inert --tenants or an
-    # unbindable port must fail in milliseconds, not after trace time
-    metrics = exporter = None
+    if trace_sample is not None:
+        if not trace_jsonl:
+            # sampling a file that will never exist is the inert-flag
+            # class this matrix refuses
+            raise SystemExit(
+                "apex-tpu-bench: --trace-sample needs --trace-jsonl "
+                "(it decides which journeys reach that file)")
+        if not 0.0 < trace_sample <= 1.0:
+            raise SystemExit(f"apex-tpu-bench: --trace-sample "
+                             f"{trace_sample:g} must be in (0, 1]")
+    # live metrics: same wiring as apex-tpu-serve — registries + the
+    # optional pull endpoint on a daemon thread, atomic snapshots at
+    # exit; the scrape-vs-bench comparability is the point
+    # (check_regression gates either artifact with the same direction
+    # hints). Fleet captures (PR 13) get one registry per replica, the
+    # merged pull endpoint at /metrics, and PATH.rK + merged PATH
+    # snapshots. Armed BEFORE the engines pay for params + compiles: an
+    # inert --tenants or an unbindable port must fail in milliseconds
+    metrics = exporter = registries = per_metrics = None
+    replica_ids = [f"r{i}" for i in range(replicas)]
     if tenants > 0 and metrics_port is None and not metrics_snapshot:
         # the labels would reach no observable output — the armed-but-
         # inert flag class this PR makes a loud usage error everywhere
@@ -368,26 +385,64 @@ def _serve_bench(steps: int, num_slots: int = 4,
             "needs --metrics-port and/or --metrics-snapshot to be "
             "observable")
     if metrics_port is not None or metrics_snapshot:
-        from apex_tpu.monitor.export import MetricsExporter
+        from apex_tpu.monitor.export import (FleetMetricsExporter,
+                                             MetricsExporter,
+                                             MetricsRegistry)
         from apex_tpu.serve.metrics import ServeMetrics
 
-        metrics = ServeMetrics()
         # provenance rides the snapshot meta: check_regression's
         # device-mismatch guard reads it, so a CPU-smoke snapshot can
         # never silently gate real-chip numbers
         metrics_meta = capture_provenance()
-        if metrics_port is not None:
-            try:
-                exporter = MetricsExporter(
-                    metrics.registry, port=metrics_port,
-                    snapshot_path=metrics_snapshot,
-                    meta=metrics_meta).start()
-            except OSError as e:
-                raise SystemExit(
-                    f"apex-tpu-bench: cannot bind --metrics-port "
-                    f"{metrics_port}: {e}")
-            print(f"apex-tpu-bench: metrics at {exporter.url}",
-                  file=sys.stderr)
+        if replicas > 1:
+            registries = {rid: MetricsRegistry() for rid in replica_ids}
+            per_metrics = {rid: ServeMetrics(registry=reg)
+                           for rid, reg in registries.items()}
+            if metrics_port is not None:
+                try:
+                    exporter = FleetMetricsExporter(
+                        registries, port=metrics_port,
+                        meta=metrics_meta).start()
+                except OSError as e:
+                    raise SystemExit(
+                        f"apex-tpu-bench: cannot bind --metrics-port "
+                        f"{metrics_port}: {e}")
+                print(f"apex-tpu-bench: fleet metrics at {exporter.url} "
+                      f"(per-replica at /metrics/rK)", file=sys.stderr)
+        else:
+            metrics = ServeMetrics()
+            if metrics_port is not None:
+                try:
+                    exporter = MetricsExporter(
+                        metrics.registry, port=metrics_port,
+                        snapshot_path=metrics_snapshot,
+                        meta=metrics_meta).start()
+                except OSError as e:
+                    raise SystemExit(
+                        f"apex-tpu-bench: cannot bind --metrics-port "
+                        f"{metrics_port}: {e}")
+                print(f"apex-tpu-bench: metrics at {exporter.url}",
+                      file=sys.stderr)
+    # tracing (PR 13): the fleet harness (journeys + PATH.rK files +
+    # tail capture) for --replicas N, a single tracer + tail-capture
+    # router otherwise — both stream through the same sampling policy
+    harness = router = tracer = None
+    if trace_jsonl:
+        rate = 1.0 if trace_sample is None else trace_sample
+        if replicas > 1:
+            from apex_tpu.serve.fleet import FleetTraceHarness
+
+            harness = FleetTraceHarness(trace_jsonl, replica_ids,
+                                        sample_rate=rate)
+        else:
+            from apex_tpu.monitor.trace import (ChromeTraceWriter,
+                                                TailCaptureRouter,
+                                                Tracer)
+
+            tracer = Tracer()
+            router = TailCaptureRouter(
+                {"": ChromeTraceWriter(trace_jsonl, subscribe=False)},
+                sample_rate=rate)
     cfg = GPT2Config.tiny()
     if max_len > cfg.n_positions:
         # the tiny preset caps context at its n_positions; a deeper bench
@@ -454,6 +509,8 @@ def _serve_bench(steps: int, num_slots: int = 4,
             max_new_tokens=8, deadline_ms=deadline_ms,
             tenant=f"tenant-{i % tenants}" if tenants > 0 else None))
     fleet = None
+    recorders = []
+    fleet_flight = single_flight = None
     if replicas > 1:
         from apex_tpu.serve.fleet import EngineReplica, FleetController
 
@@ -462,25 +519,48 @@ def _serve_bench(steps: int, num_slots: int = 4,
         # failovers/replica_dead into lower-is-better gated counters —
         # flunking the regression gate off machine noise
         fleet = FleetController(
-            [EngineReplica(f"r{i}", e, admission=_admission())
-             for i, e in enumerate(engines)],
+            [EngineReplica(
+                rid, e, admission=_admission(),
+                metrics=per_metrics[rid] if per_metrics else None,
+                tracer=harness.tracer_for(rid) if harness else None)
+             for rid, e in zip(replica_ids, engines)],
             heartbeat_ms=50.0 if heartbeat_ms is None else heartbeat_ms,
-            suspect_misses=20, dead_misses=40, hedge_ms=hedge_ms)
+            suspect_misses=20, dead_misses=40, hedge_ms=hedge_ms,
+            tracer=harness.fleet_tracer if harness else None)
+        if flight_recorder:
+            from apex_tpu.serve.fleet import attach_fleet_recorders
+
+            # per-replica postmortems + the fleet-plane recorder — the
+            # ONE wiring shared with apex-tpu-serve --replicas
+            recorders = attach_fleet_recorders(fleet, flight_recorder,
+                                               harness)
+            fleet_flight = recorders[-1]
         for spec in specs:
             fleet.submit(spec)
     else:
+        if flight_recorder:
+            from apex_tpu.monitor.flight import FlightRecorder
+
+            single_flight = FlightRecorder(flight_recorder,
+                                           tracer=tracer).attach()
+            recorders.append(single_flight)
         sched = ServeScheduler(engine, admission=_admission(),
-                               metrics=metrics)
+                               metrics=metrics, tracer=tracer,
+                               flight_recorder=single_flight)
         for spec in specs:
             sched.submit(spec)
     t0 = time.perf_counter()
     try:
+        import contextlib
+
         # the fleet runs the whole request set (its workload bound is
         # n_requests, which --steps sized above); the liveness bound
         # scales with it so a long-but-healthy run never trips a
         # TimeoutError mid-bench
-        stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(specs))) \
-            if fleet is not None else sched.run(max_steps=steps)
+        with (fleet_flight.guard("fleet") if fleet_flight is not None
+              else contextlib.nullcontext()):
+            stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(specs))) \
+                if fleet is not None else sched.run(max_steps=steps)
         # measured BEFORE the finally teardown: exporter.stop() blocks on
         # the HTTP server's shutdown poll + thread join + snapshot I/O,
         # and bench_wall_s gates lower-is-better — teardown noise must
@@ -489,11 +569,32 @@ def _serve_bench(steps: int, num_slots: int = 4,
     finally:
         if exporter is not None:
             exporter.stop()
-        elif metrics is not None and metrics_snapshot:
+        if metrics_snapshot and registries is not None:
+            # per-replica mergeable snapshots at PATH.rK plus the
+            # metrics_merge fleet view at PATH itself (the serve CLI's
+            # contract), all atomic, provenance meta on each
+            from apex_tpu.monitor.export import (atomic_write_json,
+                                                 merge_snapshots)
+
+            docs = []
+            for rid, reg in registries.items():
+                doc = reg.snapshot(meta={**(metrics_meta or {}),
+                                         "replica": rid})
+                atomic_write_json(f"{metrics_snapshot}.{rid}", doc)
+                docs.append(doc)
+            atomic_write_json(metrics_snapshot, merge_snapshots(docs))
+        elif exporter is None and metrics is not None \
+                and metrics_snapshot:
             from apex_tpu.monitor.export import write_snapshot
 
             write_snapshot(metrics.registry, metrics_snapshot,
                            meta=metrics_meta)
+        for fr in recorders:
+            fr.detach()
+        if harness is not None:
+            harness.close()
+        if router is not None:
+            router.close()
     s = stats.summary()
     if fleet is not None:
         # fleet-wide capacity/hit aggregates the single path reads off
@@ -544,6 +645,12 @@ def _serve_bench(steps: int, num_slots: int = 4,
                 "replica_dead": s["replica_dead"],
                 "migrations": s["migrations"]}
                if fleet is not None else {}),
+            # traced captures only (lower-is-better; the gate knows):
+            # every promoted journey is a bad-outcome request the tail
+            # capture had to rescue — untraced baselines simply skip it
+            **({"trace_promoted": (harness.stats() if harness is not None
+                                   else router.stats())["promoted"]}
+               if trace_jsonl else {}),
             "bench_wall_s": round(wall, 3),
             # workload config nested as a dict: check_regression lifts
             # only numeric scalars, so a capture with different
@@ -576,7 +683,17 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          # gated across incomparable configs
                          "replicas": replicas,
                          "hedge_ms": hedge_ms,
-                         "heartbeat_ms": heartbeat_ms},
+                         "heartbeat_ms": heartbeat_ms,
+                         # trace provenance (PR-8 incomparable-config
+                         # precedent): a traced capture pays host-side
+                         # span work per request — it must never gate
+                         # against an untraced baseline as if the two
+                         # measured the same thing
+                         "traced": bool(trace_jsonl),
+                         "trace_sample": (
+                             1.0 if trace_sample is None
+                             else trace_sample)
+                         if trace_jsonl else None},
             # a subset capture, not the full committed suite
             "complete": False,
         },
@@ -643,13 +760,21 @@ def main() -> None:
     with PreemptionGuard(raise_on_signal=True) as guard:
         # --flight-recorder selects this mode too: silently dropping the
         # flag would mean the requested postmortem recorder never armed —
-        # the exact silent-death failure it exists to prevent (with
-        # --serve/--kernels the mode-conflict check below refuses loudly)
-        has_telemetry = any(
-            a.split("=", 1)[0] in ("--telemetry-jsonl", "--trace-jsonl",
-                                   "--flight-recorder")
-            for a in sys.argv[1:])
+        # the exact silent-death failure it exists to prevent. With
+        # --serve, --trace-jsonl/--flight-recorder belong to the SERVE
+        # bench (PR 13: fleet journeys + per-replica postmortems), so
+        # those two no longer force the telemetry train bench —
+        # but --telemetry-jsonl stays a train-bench flag, and with
+        # --serve it must keep hitting the loud mode conflict below
+        # (the serve bench has no event mirror; swallowing the flag
+        # would be the silent-no-op class this matrix refuses)
         has_serve = any(a == "--serve" for a in sys.argv[1:])
+        has_telemetry = any(
+            a.split("=", 1)[0] == "--telemetry-jsonl"
+            for a in sys.argv[1:]) or (
+            any(a.split("=", 1)[0] in ("--trace-jsonl",
+                                       "--flight-recorder")
+                for a in sys.argv[1:]) and not has_serve)
         # --emit-baseline is shared by the serve and kernel-subset modes;
         # --kernels is NOT valid with --serve and must keep refusing
         has_subset = any(a.split("=", 1)[0] == "--kernels"
@@ -733,6 +858,21 @@ def main() -> None:
             ap.add_argument("--heartbeat-ms", type=float, default=None,
                             help="replica heartbeat interval (needs "
                                  "--replicas >= 2; default 50)")
+            ap.add_argument("--trace-jsonl", default=None,
+                            help="per-request span traces as Perfetto-"
+                                 "loadable Chrome-trace JSON; with "
+                                 "--replicas N the fleet journey lands "
+                                 "here plus one file per replica at "
+                                 "PATH.rK")
+            ap.add_argument("--trace-sample", type=float, default=None,
+                            help="seeded head-sampling rate over "
+                                 "request journeys; bad outcomes are "
+                                 "always promoted (needs --trace-jsonl)")
+            ap.add_argument("--flight-recorder", default=None,
+                            help="crash-time postmortem dump path; with "
+                                 "--replicas N one recorder per replica "
+                                 "(PATH.rK, auto-dump on that replica's "
+                                 "death) plus the fleet-plane PATH")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -750,7 +890,10 @@ def main() -> None:
                          tenants=args.tenants,
                          replicas=args.replicas,
                          hedge_ms=args.hedge_ms,
-                         heartbeat_ms=args.heartbeat_ms)
+                         heartbeat_ms=args.heartbeat_ms,
+                         trace_jsonl=args.trace_jsonl,
+                         trace_sample=args.trace_sample,
+                         flight_recorder=args.flight_recorder)
         elif has_telemetry:
             import argparse
 

@@ -50,18 +50,21 @@ from apex_tpu.monitor.metrics import (  # noqa: F401
     TrainMetrics, collect_metrics, step_flops, tree_l2norm)
 from apex_tpu.monitor.telemetry import (  # noqa: F401
     PERF_ROW_KEYS, Telemetry, read_jsonl, validate_row)
+from apex_tpu.monitor.export import FleetMetricsExporter  # noqa: F401
 from apex_tpu.monitor.trace import (  # noqa: F401
-    ChromeTraceWriter, Span, Tracer, get_tracer, read_chrome_trace,
-    set_tracer, spans_by_trace)
+    ChromeTraceWriter, Span, TailCaptureRouter, Tracer, TraceSampler,
+    get_tracer, read_chrome_trace, set_tracer, spans_by_trace)
 
 __all__ = [
     "GoodputLedger", "EVENT_SCHEMA", "TrainMetrics", "collect_metrics",
     "step_flops", "tree_l2norm", "PERF_ROW_KEYS", "Telemetry", "read_jsonl",
-    "validate_row", "Tracer", "Span", "ChromeTraceWriter", "get_tracer",
+    "validate_row", "Tracer", "Span", "ChromeTraceWriter",
+    "TraceSampler", "TailCaptureRouter", "get_tracer",
     "set_tracer", "read_chrome_trace", "spans_by_trace", "FlightRecorder",
     "thread_stacks", "MemoryAccountant", "device_memory_stats",
     "publish_compiled_memory", "sample_device_memory",
-    "MetricsRegistry", "MetricsExporter", "percentile",
-    "histogram_quantile", "merge_snapshots", "snapshot_to_prometheus",
-    "write_snapshot", "SLObjective", "SLOTracker",
+    "MetricsRegistry", "MetricsExporter", "FleetMetricsExporter",
+    "percentile", "histogram_quantile", "merge_snapshots",
+    "snapshot_to_prometheus", "write_snapshot", "SLObjective",
+    "SLOTracker",
 ]

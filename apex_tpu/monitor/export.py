@@ -641,11 +641,16 @@ class MetricsExporter:
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
+    def _make_handler(self):
+        """The request-handler class this exporter serves (overridden by
+        :class:`FleetMetricsExporter` to add per-replica routes)."""
+        return _make_handler(self.registry, self.meta)
+
     def start(self) -> "MetricsExporter":
         if self._server is not None:
             return self
         self._server = ThreadingHTTPServer(
-            (self.host, self.port), _make_handler(self.registry, self.meta))
+            (self.host, self.port), self._make_handler())
         self.port = self._server.server_address[1]
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="apex-tpu-metrics",
@@ -674,3 +679,77 @@ class MetricsExporter:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+
+def _make_fleet_handler(registries: "Dict[str, MetricsRegistry]",
+                        meta: Optional[Dict[str, Any]]):
+    def merged() -> Dict[str, Any]:
+        return merge_snapshots([
+            reg.snapshot(meta={**(meta or {}), "replica": rid})
+            for rid, reg in registries.items()])
+
+    class _FleetHandler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0]
+            doc = None
+            if path in ("/", "/metrics", "/metrics.json", "/snapshot",
+                        "/snapshot.json"):
+                doc = merged()
+            elif path.startswith("/metrics/"):
+                name = path[len("/metrics/"):]
+                if name.endswith(".json"):
+                    name = name[:-len(".json")]
+                reg = registries.get(name)
+                if reg is not None:
+                    doc = reg.snapshot(
+                        meta={**(meta or {}), "replica": name})
+            if doc is None:
+                self.send_error(
+                    404, "try /metrics, /metrics.json, or /metrics/<rid>"
+                         f" with rid in {sorted(registries)}")
+                return
+            if path.endswith(".json") or path in ("/snapshot",):
+                body = json.dumps(doc, sort_keys=True,
+                                  default=float).encode()
+                ctype = "application/json"
+            else:
+                body = snapshot_to_prometheus(doc).encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            # deferred import keeps the module standalone-importable
+            from apex_tpu.utils.logging import publish_event
+
+            publish_event("metrics_scrape", path=path, bytes=len(body))
+
+        def log_message(self, format, *args):
+            pass    # same no-spam contract as the single-registry handler
+
+    return _FleetHandler
+
+
+class FleetMetricsExporter(MetricsExporter):
+    """The fleet pull endpoint (PR 13): one HTTP server over N
+    per-replica registries. ``/metrics`` (+ ``/metrics.json``) serves
+    the :func:`merge_snapshots` **fleet view** — the exact merge, so a
+    scrape equals recording the union stream — and ``/metrics/<rid>``
+    (+ ``.json``) serves each replica's own registry, the same
+    per-replica document ``--metrics-snapshot`` commits at ``PATH.rK``.
+    Scrapes run on the HTTP thread; replica workers never see them."""
+
+    def __init__(self, registries: "Dict[str, MetricsRegistry]", *,
+                 port: int = 0, host: str = "127.0.0.1",
+                 meta: Optional[Dict[str, Any]] = None):
+        if not registries:
+            raise ValueError(
+                "FleetMetricsExporter needs at least one registry")
+        # no registry / snapshot_path: the CLI owns per-replica snapshot
+        # files (PATH.rK + the merged PATH), stop() must not write one
+        super().__init__(None, port=port, host=host, meta=meta)
+        self.registries = dict(registries)
+
+    def _make_handler(self):
+        return _make_fleet_handler(self.registries, self.meta)

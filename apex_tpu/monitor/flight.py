@@ -73,6 +73,13 @@ def _is_trigger(rec: Dict[str, Any]) -> bool:
         return True
     if ev == "collective_stall" and rec.get("escalate") in ("dump", "abort"):
         return True
+    # serving fleet (PR 13): a replica declared dead — or escalated to
+    # suspect, the watchdog-style early warning — gets its postmortem
+    # captured the moment the registry sweep announces it (the
+    # per-replica recorder's trigger_filter scopes each dump to ITS
+    # replica's transitions)
+    if ev in ("serve_replica_dead", "serve_replica_suspect"):
+        return True
     return False
 
 
@@ -96,11 +103,20 @@ class FlightRecorder:
     """
 
     def __init__(self, path: str, *, capacity: int = 256, tracer=None,
-                 auto_dump: bool = True):
+                 auto_dump: bool = True, trigger_filter=None,
+                 context_fn=None):
         self.path = path
         self.capacity = max(1, int(capacity))
         self.tracer = tracer
         self.auto_dump = auto_dump
+        # trigger_filter(rec) -> bool: an extra predicate over the
+        # trigger records — a fleet's per-replica recorder dumps only on
+        # ITS replica's death/suspect transition, not every peer's
+        self.trigger_filter = trigger_filter
+        # context_fn() -> dict, captured at dump time under "context":
+        # the fleet wires the replica's registry row (state, last beat,
+        # silence age) so a death postmortem says WHICH row died and how
+        self.context_fn = context_fn
         self.events: collections.deque = collections.deque(
             maxlen=self.capacity)
         self.total_events = 0
@@ -140,7 +156,8 @@ class FlightRecorder:
             self.events.append(rec)
             if rec.get("event") == "hbm_snapshot":
                 self.last_hbm = rec
-        if self.auto_dump and _is_trigger(rec):
+        if self.auto_dump and _is_trigger(rec) and (
+                self.trigger_filter is None or self.trigger_filter(rec)):
             self.dump(reason=str(rec.get("event")))
 
     # ---- the postmortem ------------------------------------------------
@@ -153,7 +170,7 @@ class FlightRecorder:
             events = list(self.events)
             total = self.total_events
             last_hbm = self.last_hbm
-        return {
+        out = {
             "schema": SCHEMA_VERSION,
             "reason": reason,
             "t": time.time(),
@@ -166,6 +183,13 @@ class FlightRecorder:
             "hbm_snapshot": last_hbm,
             "thread_stacks": thread_stacks(),
         }
+        if self.context_fn is not None:
+            try:
+                out["context"] = self.context_fn()
+            except Exception as e:
+                # the postmortem must never die on its own garnish
+                out["context"] = {"error": repr(e)}
+        return out
 
     def dump(self, reason: str = "manual") -> str:
         """Write the postmortem atomically (stage to ``.tmp``, publish

@@ -95,6 +95,12 @@ COUNTED_EVENTS = (
     "serve_replica_suspect", "serve_replica_dead",
     "serve_hedge_fired",
     "serve_replica_drained", "serve_replica_restarted",
+    # fleet request journeys (monitor.trace TailCaptureRouter): a
+    # head-sample-dropped journey's full span ring was retroactively
+    # promoted into the trace file because its outcome turned bad —
+    # counted, because every promotion is a bad-outcome request (the
+    # regression gate treats trace_promoted as lower-is-better)
+    "serve_trace_promoted",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

@@ -1,0 +1,437 @@
+"""Jax-free request-journey analysis — merge fleet + per-replica trace
+files into per-request latency attribution that reconciles EXACTLY with
+the fleet summary and the goodput ledger's timed causes.
+
+A *journey* is the complete cross-replica trace of one serving request
+(:mod:`apex_tpu.serve.fleet` opens it): a ``journey`` root span with
+``fleet_queue → attempt[replica=k] → backoff → hedge → failover →
+terminal`` children, plus — nested under each attempt — the replica
+scheduler's own ``request → queue/prefill/decode`` trace (PR 6), all
+sharing one ``trace_id``. Single-scheduler runs root at ``request``
+instead; the attribution here handles both.
+
+The reconciliation contract (``tools/trace_explain.py`` exits 1 when it
+fails — the reconciliation IS the test):
+
+- fleet-plane spans are stamped from the SAME clock reads the fleet
+  summary and the ``serve_failover`` events use, and carry the rounded
+  ``seconds``/``ttft_s``/``latency_s`` values as attrs — so sums here
+  equal the ledger's timed causes and the summary's percentiles
+  *exactly*, not approximately;
+- the winning attempt's replica spans obey the PR-6 identities
+  (``queue + prefill + decode == latency`` within stamp rounding).
+
+This module is deliberately **stdlib-only at import time** and loads its
+one helper (:func:`percentile` from ``monitor/export.py``) by file path,
+so ``tools/trace_explain.py`` can load *this* module by path and run on
+a machine with no jax installed (the ``tools/metrics_merge.py``
+pattern). Tier-1 asserts :data:`SERVE_TIMED_CAUSES` stays equal to the
+serve subset of ``goodput.STALL_EVENTS`` — the one mapping, two homes,
+cross-checked so they can never drift.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+
+# serve events whose records carry a ``seconds`` field of lost time —
+# MUST mirror the serve_* subset of goodput.STALL_EVENTS (tier-1 holds
+# them equal; goodput.py imports the package and cannot be loaded here)
+SERVE_TIMED_CAUSES = {
+    "serve_queue_wait": "serve_queue_wait",
+    "serve_deadline_exceeded": "serve_deadline_exceeded",
+    "serve_request_rejected": "serve_rejected",
+    "serve_page_alloc_fail": "serve_page_alloc_fail",
+    "serve_failover": "serve_failover",
+}
+
+# journey trace ids: "journey:<request_id>" (fleet) / "request:<request_id>"
+JOURNEY_PREFIXES = ("journey:", "request:")
+
+_EXPORT_MOD = None
+
+
+def _export():
+    """``monitor/export.py`` loaded by file path (never via the package —
+    whose ``__init__`` pulls jax): the ONE nearest-rank percentile rule,
+    not a second spelling that could silently diverge."""
+    global _EXPORT_MOD
+    if _EXPORT_MOD is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "export.py")
+        spec = importlib.util.spec_from_file_location(
+            "_apex_tpu_export_for_journey", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _EXPORT_MOD = mod
+    return _EXPORT_MOD
+
+
+def percentile(values: Iterable[float], p: float) -> float:
+    """THE repo percentile (delegates to ``export.percentile`` by path)."""
+    return _export().percentile(values, p)
+
+
+# ----------------------------------------------------- trace-file loading
+
+def read_chrome_trace(path: str) -> List[Dict[str, Any]]:
+    """Parse a Chrome-trace file, tolerating the unterminated array a
+    crashed run leaves behind (exactly what Perfetto tolerates)."""
+    with open(path) as f:
+        text = f.read().strip()
+    if not text.startswith("["):
+        raise ValueError(f"{path}: not a Chrome-trace JSON array")
+    if text.endswith(","):
+        text = text[:-1]
+    if not text.endswith("]"):
+        text += "]"
+    return json.loads(text)
+
+
+def spans_by_trace(records: List[Dict[str, Any]]
+                   ) -> Dict[str, List[Dict[str, Any]]]:
+    """Group span records (bus ``span_close`` records or a tracer's
+    ``completed_records()``) by ``trace_id`` — one entry per request/step
+    trace, spans in id (open) order."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        out.setdefault(str(rec.get("trace_id")), []).append(rec)
+    for spans in out.values():
+        spans.sort(key=lambda r: r.get("span_id") or 0)
+    return out
+
+
+def chrome_events_to_spans(events: List[Dict[str, Any]]
+                           ) -> List[Dict[str, Any]]:
+    """Invert :class:`~apex_tpu.monitor.trace.ChromeTraceWriter`: the
+    ``"X"`` events of a trace file back into span records
+    (``trace_id/span_id/parent_id/name/t0/t1/status/attrs``). ``ts`` is
+    microseconds since the writer's process epoch, shared by every file
+    the process wrote — fleet and per-replica timelines align."""
+    out: List[Dict[str, Any]] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = dict(ev.get("args") or {})
+        rec: Dict[str, Any] = {
+            "trace_id": str(args.pop("trace_id", None)),
+            "span_id": args.pop("span_id", None),
+            "parent_id": args.pop("parent_id", None),
+            "status": args.pop("status", "ok"),
+            "name": ev.get("name", "?"),
+            "t0": float(ev["ts"]) / 1e6,
+            "t1": (float(ev["ts"]) + float(ev["dur"])) / 1e6,
+            "attrs": args,
+        }
+        out.append(rec)
+    return out
+
+
+def load_trace_files(paths: Iterable[str]) -> List[Dict[str, Any]]:
+    """All span records across fleet + per-replica Chrome-trace files."""
+    records: List[Dict[str, Any]] = []
+    for path in paths:
+        records.extend(chrome_events_to_spans(read_chrome_trace(path)))
+    return records
+
+
+def read_events_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Telemetry event-mirror lines (one JSON record per line; rows
+    without an ``event`` key — step metrics — are skipped)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if isinstance(rec, dict) and "event" in rec:
+                out.append(rec)
+    return out
+
+
+def ledger_causes(events: Iterable[Mapping[str, Any]]
+                  ) -> Tuple[Dict[str, float], Dict[str, int]]:
+    """Recompute the goodput ledger's serve-side timed causes and event
+    counts from a mirrored event stream — what an attached
+    ``GoodputLedger`` would have accumulated, without importing it."""
+    causes: Dict[str, float] = {}
+    counts: Dict[str, int] = {}
+    for rec in events:
+        name = rec.get("event")
+        counts[name] = counts.get(name, 0) + 1
+        cause = SERVE_TIMED_CAUSES.get(name)
+        if cause is not None:
+            causes[cause] = causes.get(cause, 0.0) \
+                + float(rec.get("seconds", 0.0))
+    return causes, counts
+
+
+# ----------------------------------------------------------- attribution
+
+def _dur(span: Mapping[str, Any]) -> float:
+    return float(span.get("t1", span.get("t0", 0.0))) \
+        - float(span.get("t0", 0.0))
+
+
+def attribute_journeys(records: List[Dict[str, Any]]
+                       ) -> List[Dict[str, Any]]:
+    """Per-request latency attribution from merged span records.
+
+    Each journey contributes one row::
+
+        {request_id, trace_id, state, finish_reason, replica,
+         latency_s, ttft_s,
+         fleet_queue_s, backoff_s, failover_lost_s,
+         queue_s, prefill_s, decode_s,
+         attempts, hedged, hedge_margin_s, failovers, migrations,
+         retries, dominant, spans}
+
+    ``latency_s``/``ttft_s``/``failover_lost_s`` come from span *attrs*
+    (the exact rounded values the summary and ledger carry); the
+    ``queue/prefill/decode`` components come from the winning attempt's
+    replica spans (the PR-6 stamps). ``dominant`` names the largest
+    component."""
+    out: List[Dict[str, Any]] = []
+    for trace_id, spans in sorted(spans_by_trace(records).items()):
+        if not trace_id.startswith(JOURNEY_PREFIXES):
+            continue
+        roots = [s for s in spans if s.get("parent_id") is None]
+        if not roots:
+            continue    # partial capture (crashed writer): skip, loudly
+        root = roots[0]
+        attrs = root.get("attrs") or {}
+        rid = str(attrs.get("request_id",
+                            trace_id.split(":", 1)[1]))
+        by_name: Dict[str, List[Dict[str, Any]]] = {}
+        for s in spans:
+            by_name.setdefault(s["name"], []).append(s)
+        terminal = (by_name.get("terminal") or [None])[0]
+        term_attrs = (terminal.get("attrs") if terminal else None) or {}
+        # single-scheduler journeys: the scheduler root carries the exact
+        # latency/ttft attrs and status; fleet ones carry them on the
+        # fleet "terminal" span (copied from the winning record)
+        state = term_attrs.get("state") or attrs.get("state")
+        finish = term_attrs.get("finish_reason") \
+            or attrs.get("finish_reason")
+        replica = term_attrs.get("replica")
+        latency = term_attrs.get("latency_s", attrs.get("latency_s"))
+        ttft = term_attrs.get("ttft_s", attrs.get("ttft_s"))
+        failover_spans = by_name.get("failover", [])
+        failover_lost = sum(float((s.get("attrs") or {})
+                                  .get("seconds", _dur(s)))
+                            for s in failover_spans)
+        failovers = sum((s.get("attrs") or {}).get("cause")
+                        == "replica_dead" for s in failover_spans)
+        migrations = sum((s.get("attrs") or {}).get("cause") == "drain"
+                         for s in failover_spans)
+        backoffs = by_name.get("backoff", [])
+        hedges = by_name.get("hedge", [])
+        attempts = by_name.get("attempt", [])
+        # winning attempt: the one the terminal names; its replica
+        # "request" root holds the PR-6 queue/prefill/decode stamps.
+        # Single-scheduler journeys have exactly one "request" root.
+        req_roots = by_name.get("request", [])
+        win_root = None
+        if replica is not None:
+            # the LATEST attempt on the terminal replica wins (a journey
+            # can revisit a replica: reject -> backoff -> re-dispatch)
+            win_att = next((a for a in reversed(attempts)
+                            if (a.get("attrs") or {}).get("replica")
+                            == replica), None)
+            if win_att is not None:
+                win_root = next(
+                    (r for r in req_roots
+                     if r.get("parent_id") == win_att.get("span_id")),
+                    None)
+        if win_root is None and len(req_roots) == 1:
+            win_root = req_roots[0]
+
+        def _child(name: str) -> float:
+            if win_root is None:
+                return 0.0
+            for s in by_name.get(name, []):
+                if s.get("parent_id") == win_root.get("span_id"):
+                    return _dur(s)
+            return 0.0
+
+        comp = {
+            "fleet_queue_s": sum(_dur(s)
+                                 for s in by_name.get("fleet_queue", [])),
+            "backoff_s": sum(_dur(s) for s in backoffs),
+            "failover_lost_s": failover_lost,
+            "queue_s": _child("queue"),
+            "prefill_s": _child("prefill"),
+            "decode_s": _child("decode"),
+        }
+        dominant = max(comp, key=lambda k: comp[k]) if any(
+            v > 0 for v in comp.values()) else "queue_s"
+        row: Dict[str, Any] = {
+            "request_id": rid, "trace_id": trace_id,
+            "state": state, "finish_reason": finish, "replica": replica,
+            "latency_s": float(latency) if latency is not None
+            else _dur(root),
+            "ttft_s": float(ttft) if ttft is not None else None,
+            **{k: round(v, 6) for k, v in comp.items()},
+            "attempts": max(len(attempts), 1 if win_root else 0),
+            "hedged": bool(hedges),
+            "hedge_margin_s": round(
+                float(root.get("t1", 0.0))
+                - float(hedges[0].get("t0", 0.0)), 6) if hedges else None,
+            "failovers": failovers,
+            "migrations": migrations,
+            "retries": len(backoffs),
+            "dominant": dominant,
+            "spans": len(spans),
+        }
+        out.append(row)
+    return out
+
+
+def top_slowest(journeys: List[Dict[str, Any]], k: int = 10
+                ) -> List[Dict[str, Any]]:
+    return sorted(journeys, key=lambda j: j.get("latency_s") or 0.0,
+                  reverse=True)[:k]
+
+
+# --------------------------------------------------------- reconciliation
+
+def _close(a: float, b: float, tol: float = 1e-9) -> bool:
+    return abs(a - b) <= tol * max(1.0, abs(a), abs(b))
+
+
+def reconcile(journeys: List[Dict[str, Any]],
+              records: List[Dict[str, Any]],
+              summary: Optional[Mapping[str, Any]] = None,
+              causes: Optional[Mapping[str, float]] = None,
+              counts: Optional[Mapping[str, int]] = None,
+              *, stamp_tol_s: float = 2e-3,
+              complete_capture: bool = True) -> List[str]:
+    """Verify the attribution against the fleet summary and the ledger's
+    timed causes. Returns human-readable mismatch strings (empty =
+    reconciled).
+
+    ``complete_capture=False`` (a head-sampled run) skips every check
+    that needs ALL journeys present (counts, percentiles); the
+    bad-outcome checks still hold — tail capture promises those journeys
+    are always captured."""
+    problems: List[str] = []
+    by_trace = spans_by_trace(records)
+    # per-journey internal sums: the PR-6 identities on the winning
+    # attempt (span stamps round to the microsecond — stamp_tol covers
+    # the rounding, nothing else)
+    for j in journeys:
+        if j["state"] != "completed" or j.get("latency_s") is None:
+            continue
+        parts = j["queue_s"] + j["prefill_s"] + j["decode_s"]
+        if abs(parts - j["latency_s"]) > stamp_tol_s:
+            problems.append(
+                f"journey {j['request_id']}: queue+prefill+decode = "
+                f"{parts:.6f}s does not sum to latency "
+                f"{j['latency_s']:.6f}s")
+    if summary is not None and complete_capture:
+        ids = [j["request_id"] for j in journeys]
+        if len(ids) != len(set(ids)):
+            problems.append("duplicate journeys: a request traced twice")
+        if len(journeys) != summary.get("requests"):
+            problems.append(
+                f"{len(journeys)} journeys != summary requests "
+                f"{summary.get('requests')} (want exactly one fleet "
+                f"trace per submitted request)")
+        for state, key in (("completed", "completed"),
+                           ("evicted", "evicted"),
+                           ("rejected", "rejected")):
+            got = sum(j["state"] == state for j in journeys)
+            if got != summary.get(key, 0):
+                problems.append(f"{got} {state} journeys != summary "
+                                f"{key} {summary.get(key)}")
+        got = sum(j["finish_reason"] == "deadline" for j in journeys)
+        if got != summary.get("deadline_exceeded", 0):
+            problems.append(
+                f"{got} deadline journeys != summary deadline_exceeded "
+                f"{summary.get('deadline_exceeded')}")
+        for key, field in (("failovers", "failovers"),
+                           ("migrations", "migrations"),
+                           ("retries", "retries")):
+            got = sum(j[field] for j in journeys)
+            if key in summary and got != summary[key]:
+                problems.append(f"{got} {field} spans != summary "
+                                f"{key} {summary[key]}")
+        if "hedge_fired" in summary:
+            got = sum(j["hedged"] for j in journeys)
+            if got != summary["hedge_fired"]:
+                problems.append(f"{got} hedge spans != summary "
+                                f"hedge_fired {summary['hedge_fired']}")
+        # TTFT percentiles: journey ttfts are the EXACT rounded values
+        # the summary computed its own percentiles from — equality is
+        # bit-for-bit, not approximate
+        ttfts = [j["ttft_s"] for j in journeys
+                 if j.get("ttft_s") is not None]
+        for p, key in ((0.50, "ttft_p50_ms"), (0.99, "ttft_p99_ms")):
+            if key in summary:
+                want = summary[key]
+                got = round(percentile(ttfts, p) * 1e3, 3)
+                if got != want:
+                    problems.append(
+                        f"journey ttft {key}: {got} != summary {want}")
+    if causes is not None:
+        # the failover ledger cause vs the failover spans' attrs: both
+        # sum the SAME rounded per-event seconds — exact
+        span_total = 0.0
+        span_count = 0
+        for spans in by_trace.values():
+            for s in spans:
+                if s["name"] == "failover":
+                    span_total += float((s.get("attrs") or {})
+                                        .get("seconds", 0.0))
+                    span_count += 1
+        want = float(causes.get("serve_failover", 0.0))
+        if not _close(span_total, want):
+            problems.append(
+                f"failover span seconds sum {span_total:.6f} != ledger "
+                f"serve_failover cause {want:.6f}")
+        if counts is not None:
+            n = counts.get("serve_failover", 0)
+            if span_count != n:
+                problems.append(f"{span_count} failover spans != "
+                                f"{n} serve_failover events")
+    return problems
+
+
+# -------------------------------------------------- merged Perfetto view
+
+def merged_perfetto(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """One merged Chrome-trace event list with **one track per replica**
+    (the ``track`` attr every fleet-run tracer stamps: ``fleet``,
+    ``r0``..``rN``; untagged spans land on ``host``) — the side-by-side
+    view of a request hopping replicas that per-file traces cannot
+    show."""
+    tracks: Dict[str, int] = {}
+    events: List[Dict[str, Any]] = []
+    t_base = min((float(r.get("t0", 0.0)) for r in records),
+                 default=0.0)
+    for rec in sorted(records, key=lambda r: float(r.get("t0", 0.0))):
+        attrs = rec.get("attrs") or {}
+        track = str(attrs.get("track", "host"))
+        tid = tracks.get(track)
+        if tid is None:
+            tid = tracks[track] = len(tracks) + 1
+            events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                           "tid": tid, "args": {"name": track}})
+        args = {"trace_id": rec.get("trace_id"),
+                "span_id": rec.get("span_id"),
+                "parent_id": rec.get("parent_id"),
+                "status": rec.get("status")}
+        args.update(attrs)
+        events.append({
+            "ph": "X", "cat": "journey", "name": rec.get("name", "?"),
+            "pid": 1, "tid": tid,
+            "ts": round((float(rec["t0"]) - t_base) * 1e6, 3),
+            "dur": round(_dur(rec) * 1e6, 3),
+            "args": args,
+        })
+    return events

@@ -21,6 +21,15 @@ or one train step together end to end. This module adds that thread:
   ``"X"`` events (one JSON object per line inside a JSON array), the
   format Perfetto and ``chrome://tracing`` load directly. Each trace gets
   its own ``tid`` track, so a serving run renders as one row per request.
+- :class:`TraceSampler` / :class:`TailCaptureRouter` — fleet-scale trace
+  volume control (PR 13): deterministic seeded head sampling over request
+  *journeys* plus a bounded per-journey span ring that retroactively
+  **promotes** a journey into the trace file the moment its outcome turns
+  bad (deadline/evict/reject/failover/hedge, or any terminal inside an
+  SLO-breach window) — the slow tail is always captured, the happy path
+  is sampled. The router also splits one bus stream across several
+  writers by the tracer's ``track`` tag (fleet file + one file per
+  replica).
 
 The default process tracer is **disabled**: ``tracer.span(...)`` yields
 ``None``, publishes nothing, and allocates nothing but a generator frame
@@ -45,12 +54,26 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from apex_tpu.monitor.journey import (JOURNEY_PREFIXES, read_chrome_trace,
+                                      spans_by_trace)
 from apex_tpu.utils.logging import publish_event, subscribe_events
+
+__all__ = [
+    "Span", "Tracer", "ChromeTraceWriter", "TraceSampler",
+    "TailCaptureRouter", "get_tracer", "set_tracer",
+    "read_chrome_trace", "spans_by_trace",
+]
 
 # one process-wide origin for Chrome-trace timestamps: every span's
 # ``ts`` is microseconds since this module imported, so spans from
 # different tracers/threads share a timeline
 _EPOCH = time.perf_counter()
+
+# one process-wide span-id sequence: a fleet run has one tracer per
+# replica plus the controller's, all stamping spans into the SAME
+# journey trace — per-tracer counters would collide and break parent
+# links in the merged analysis
+_SPAN_IDS = itertools.count(1)
 
 # sentinel: "use the ambient contextvar parent" (None means "force root")
 _AMBIENT = object()
@@ -115,9 +138,15 @@ class Tracer:
     queryable for the flight recorder's "what was in flight" dump.
     """
 
-    def __init__(self, enabled: bool = True, *, max_completed: int = 65536):
+    def __init__(self, enabled: bool = True, *, max_completed: int = 65536,
+                 tags: Optional[Dict[str, Any]] = None):
         self.enabled = enabled
-        self._span_ids = itertools.count(1)
+        # identity attrs stamped on EVERY span this tracer opens (the
+        # fleet harness tags each replica's tracer ``track="rK"`` so one
+        # bus stream splits into per-replica trace files and the merged
+        # Perfetto view renders one track per replica). Tags win over
+        # same-named call-site attrs — they are the tracer's identity.
+        self.tags = dict(tags) if tags else {}
         self._trace_ids = itertools.count(1)
         self._lock = threading.Lock()
         self._open: Dict[int, Span] = {}
@@ -134,18 +163,26 @@ class Tracer:
         return self._current.get()
 
     def begin(self, name: str, *, parent: Optional[Span] = None,
-              trace_id: Optional[str] = None, t0: Optional[float] = None,
+              trace_id: Optional[str] = None,
+              parent_id: Optional[int] = None,
+              t0: Optional[float] = None,
               **attrs: Any) -> Optional[Span]:
         """Open a span. ``parent`` wins over ``trace_id``; with neither,
-        the span roots a new trace. Returns ``None`` when disabled."""
+        the span roots a new trace. ``parent_id`` (with an explicit
+        ``trace_id``) links under a span another tracer owns — the
+        cross-component propagation seam: a replica scheduler's request
+        trace nests under the fleet controller's attempt span. Returns
+        ``None`` when disabled."""
         if not self.enabled:
             return None
         if parent is not None:
             trace_id = parent.trace_id
+            parent_id = parent.span_id
         elif trace_id is None:
             trace_id = self.new_trace_id(name)
-        span = Span(trace_id, next(self._span_ids),
-                    parent.span_id if parent is not None else None,
+        if self.tags:
+            attrs = {**attrs, **self.tags}
+        span = Span(trace_id, next(_SPAN_IDS), parent_id,
                     name, t0 if t0 is not None else time.perf_counter(),
                     dict(attrs))
         with self._lock:
@@ -259,7 +296,8 @@ class ChromeTraceWriter:
     serve request / train step.
     """
 
-    def __init__(self, path: str, *, pid: Optional[int] = None):
+    def __init__(self, path: str, *, pid: Optional[int] = None,
+                 subscribe: bool = True):
         import os
 
         self.path = path
@@ -273,7 +311,11 @@ class ChromeTraceWriter:
         # the comma/newline framing must not interleave
         self._lock = threading.Lock()
         self.events = 0
-        self._unsubscribe = subscribe_events(self._on_event)
+        # subscribe=False makes the writer a pure sink fed through
+        # write_span() — the TailCaptureRouter owns the bus subscription
+        # and routes/samples/promotes before anything reaches a file
+        self._unsubscribe = subscribe_events(self._on_event) \
+            if subscribe else None
 
     def _on_event(self, rec: Dict[str, Any]) -> None:
         if rec.get("event") == "span_close":
@@ -331,27 +373,228 @@ class ChromeTraceWriter:
         self.close()
 
 
-def read_chrome_trace(path: str) -> List[Dict[str, Any]]:
-    """Parse a Chrome-trace file, tolerating the unterminated array a
-    crashed run leaves behind (exactly what Perfetto tolerates)."""
-    with open(path) as f:
-        text = f.read().strip()
-    if not text.startswith("["):
-        raise ValueError(f"{path}: not a Chrome-trace JSON array")
-    if text.endswith(","):
-        text = text[:-1]
-    if not text.endswith("]"):
-        text += "]"
-    return json.loads(text)
+# read_chrome_trace / spans_by_trace live in monitor/journey.py now
+# (stdlib-only, loadable by path from tools/trace_explain.py) and are
+# re-exported above for every existing caller.
 
 
-def spans_by_trace(records: List[Dict[str, Any]]) -> Dict[str, List[Dict[str, Any]]]:
-    """Group span records (bus ``span_close`` records or a tracer's
-    ``completed_records()``) by ``trace_id`` — one entry per request/step
-    trace, spans in id (open) order."""
-    out: Dict[str, List[Dict[str, Any]]] = {}
-    for rec in records:
-        out.setdefault(str(rec.get("trace_id")), []).append(rec)
-    for spans in out.values():
-        spans.sort(key=lambda r: r.get("span_id") or 0)
-    return out
+# --------------------------------------------------------------------------
+# head sampling + tail capture (fleet-scale trace volume control)
+# --------------------------------------------------------------------------
+
+class TraceSampler:
+    """Deterministic head sampling: ``sampled(key)`` is a pure function
+    of ``(seed, key)`` — every process, replica, and re-run agrees on
+    which journeys stream, so a fleet's writers never disagree about a
+    request and a test can predict the sample set exactly. ``rate=1``
+    samples everything (today's behavior)."""
+
+    def __init__(self, rate: float = 1.0, *, seed: int = 0):
+        if not 0.0 < rate <= 1.0:
+            raise ValueError(f"sample rate must be in (0, 1]: {rate}")
+        self.rate = float(rate)
+        self.seed = int(seed)
+
+    def sampled(self, key: str) -> bool:
+        if self.rate >= 1.0:
+            return True
+        import hashlib
+
+        h = hashlib.blake2b(f"{self.seed}:{key}".encode(),
+                            digest_size=8).digest()
+        frac = int.from_bytes(h, "big") / 2.0 ** 64
+        return frac < self.rate
+
+
+# lifecycle events that turn a journey's outcome BAD: its full span ring
+# is promoted into the trace file even when head sampling dropped it
+_BAD_OUTCOME_EVENTS = frozenset((
+    "serve_request_evicted", "serve_deadline_exceeded",
+    "serve_request_rejected", "serve_failover", "serve_hedge_fired",
+))
+# terminal lifecycle events: the journey's keep-or-drop decision point
+# (every request reaches exactly one of these per attempt — PR-8's
+# exactly-once contract; the fleet journey root close is the fallback
+# decider for synthetic terminals that publish no event)
+_TERMINAL_EVENTS = frozenset((
+    "serve_request_completed", "serve_request_evicted",
+    "serve_request_rejected", "serve_deadline_exceeded",
+))
+
+
+class TailCaptureRouter:
+    """The seam between ``span_close`` bus records and Chrome-trace
+    writers: route by the tracer's ``track`` tag, head-sample request
+    journeys, and retroactively promote the journeys that go bad.
+
+    - **Routing** — ``writers`` maps a ``track`` tag (``"fleet"``,
+      ``"r0"``...) to a :class:`ChromeTraceWriter` built with
+      ``subscribe=False``; spans with no (or an unknown) track land on
+      the default writer. Non-journey traces (the per-tick scheduler
+      trace, train steps) always stream.
+    - **Sampling** — a journey (trace id ``journey:<rid>`` /
+      ``request:<rid>``) streams immediately when the seeded
+      :class:`TraceSampler` picks it; otherwise its spans buffer in a
+      bounded per-journey ring.
+    - **Tail capture** — the journey's terminal lifecycle event decides:
+      a bad outcome anywhere in its life (deadline/evict/reject/
+      failover/hedge — or ANY terminal inside an SLO-breach window)
+      flushes the ring into the writers and publishes
+      ``serve_trace_promoted``; a happy terminal drops the ring. The
+      slow tail is always captured; only the happy path is sampled.
+
+    Span records arrive on whichever thread closed the span (replica
+    workers, the control thread) — every ring/decision mutation holds
+    ``_lock``; bus publishes happen outside it (the bus's own rule)."""
+
+    def __init__(self, writers: Dict[str, ChromeTraceWriter], *,
+                 sample_rate: float = 1.0, sample_seed: int = 0,
+                 ring_spans: int = 256, max_decided: int = 65536):
+        if not writers:
+            raise ValueError("TailCaptureRouter needs at least one writer")
+        self.writers = dict(writers)
+        self._default_writer = next(iter(self.writers.values()))
+        self.sampler = TraceSampler(sample_rate, seed=sample_seed)
+        self.ring_spans = max(1, int(ring_spans))
+        self.max_decided = max(16, int(max_decided))
+        self._lock = threading.Lock()
+        # per-journey buffered span records, awaiting the outcome
+        self._rings: Dict[str, collections.deque] = {}
+        # trace_id -> True (write-through) / False (dropped)
+        self._decided: Dict[str, bool] = {}
+        # request_id -> the event that turned the journey bad
+        self._bad: Dict[str, str] = {}
+        self._breached: set = set()
+        self.sampled = 0      # journeys streamed by head sampling
+        self.promoted = 0     # bad-outcome journeys flushed from a ring
+        self.dropped = 0      # happy-path journeys discarded
+        self._unsubscribe = subscribe_events(self._on_event)
+
+    # ---- bus wiring ----------------------------------------------------
+    def close(self) -> None:
+        """Unsubscribe and close every writer (undecided rings are
+        dropped — the run is over, there is no outcome left to wait
+        for)."""
+        if self._unsubscribe is not None:
+            self._unsubscribe()
+            self._unsubscribe = None
+        for w in self.writers.values():
+            w.close()
+
+    def __enter__(self) -> "TailCaptureRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"sampled": self.sampled, "promoted": self.promoted,
+                    "dropped": self.dropped}
+
+    # ---- event handling ------------------------------------------------
+    def _on_event(self, rec: Dict[str, Any]) -> None:
+        ev = rec.get("event")
+        if ev == "span_close":
+            self._route_span(rec)
+            return
+        if ev == "serve_slo_breach":
+            with self._lock:
+                self._breached.add(str(rec.get("objective")))
+            return
+        if ev == "serve_slo_recovered":
+            with self._lock:
+                self._breached.discard(str(rec.get("objective")))
+            return
+        if ev in _BAD_OUTCOME_EVENTS and "request_id" in rec:
+            with self._lock:
+                self._mark_bad(str(rec["request_id"]), str(ev))
+        if ev in _TERMINAL_EVENTS and "request_id" in rec:
+            self._decide(str(rec["request_id"]))
+
+    def _mark_bad(self, rid: str, why: str) -> None:
+        # caller holds self._lock
+        if rid not in self._bad:
+            if len(self._bad) >= self.max_decided:
+                self._bad.pop(next(iter(self._bad)))
+            self._bad[rid] = why
+
+    def _writer_for(self, rec: Dict[str, Any]) -> ChromeTraceWriter:
+        track = (rec.get("attrs") or {}).get("track")
+        return self.writers.get(str(track), self._default_writer)
+
+    def _route_span(self, rec: Dict[str, Any]) -> None:
+        tid = str(rec.get("trace_id"))
+        if not tid.startswith(JOURNEY_PREFIXES):
+            self._writer_for(rec).write_span(rec)
+            return
+        promote_payload = None
+        with self._lock:
+            verdict = self._decided.get(tid)
+            if verdict is None:
+                if self.sampler.sampled(tid):
+                    self._remember(tid, True)
+                    self.sampled += 1
+                    verdict = True
+                else:
+                    ring = self._rings.get(tid)
+                    if ring is None:
+                        ring = self._rings[tid] = collections.deque(
+                            maxlen=self.ring_spans)
+                    ring.append(rec)
+                    if rec.get("parent_id") is None \
+                            and tid.startswith("journey:"):
+                        # fallback decider: a fleet journey whose
+                        # synthetic terminal published no lifecycle
+                        # event (total fleet loss) still settles when
+                        # its root — closed after every fleet event by
+                        # contract — arrives
+                        promote_payload = self._decide_locked(
+                            tid.split(":", 1)[1])
+                    verdict = None
+            if verdict is True:
+                self._writer_for(rec).write_span(rec)
+        if promote_payload is not None:
+            self._publish_promoted(*promote_payload)
+
+    def _remember(self, tid: str, verdict: bool) -> None:
+        # caller holds self._lock
+        if len(self._decided) >= self.max_decided:
+            self._decided.pop(next(iter(self._decided)))
+        self._decided[tid] = verdict
+
+    def _decide(self, rid: str) -> None:
+        with self._lock:
+            payload = self._decide_locked(rid)
+        if payload is not None:
+            self._publish_promoted(*payload)
+
+    def _decide_locked(self, rid: str):
+        # caller holds self._lock; returns a (rid, why, spans) payload
+        # when a promotion event must publish (outside the lock)
+        payload = None
+        for tid in (f"journey:{rid}", f"request:{rid}"):
+            if tid in self._decided:
+                continue
+            ring = self._rings.pop(tid, None)
+            bad = self._bad.get(rid)
+            if bad is None and self._breached:
+                bad = "slo_breach:" + ",".join(sorted(self._breached))
+            if bad is not None:
+                self._remember(tid, True)
+                if ring is not None:
+                    for buffered in ring:
+                        self._writer_for(buffered).write_span(buffered)
+                    self.promoted += 1
+                    payload = (rid, bad, len(ring))
+            elif ring is not None:
+                # a happy journey we actually saw spans for: drop it.
+                # (Without a ring there is nothing to decide — the
+                # request was never traced into this router.)
+                self._remember(tid, False)
+                self.dropped += 1
+        return payload
+
+    def _publish_promoted(self, rid: str, why: str, spans: int) -> None:
+        publish_event("serve_trace_promoted", emit=False,
+                      request_id=rid, reason=why, buffered_spans=spans)

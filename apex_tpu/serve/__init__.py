@@ -53,7 +53,7 @@ overload/failure contracts.
 from apex_tpu.serve.engine import Engine, EngineConfig  # noqa: F401
 from apex_tpu.serve.fleet import (EngineReplica,  # noqa: F401
                                   FleetController, FleetStats,
-                                  ReplicaRegistry)
+                                  FleetTraceHarness, ReplicaRegistry)
 from apex_tpu.serve.kv_cache import (KVCache, evict_slots,  # noqa: F401
                                      init_cache, write_token)
 from apex_tpu.serve.metrics import ServeMetrics  # noqa: F401
@@ -69,4 +69,5 @@ __all__ = [
     "AdmissionController", "TickJournal", "ServeSupervisor",
     "SHED_POLICIES", "ServeMetrics",
     "FleetController", "EngineReplica", "ReplicaRegistry", "FleetStats",
+    "FleetTraceHarness",
 ]

@@ -57,11 +57,27 @@ requests as retriable rejections — a healthy fleet can serve them —
 finish in-flight ones, exit cleanly). The summary gains ``failovers`` /
 ``hedge_fired`` / ``migrations``; ``--metrics-snapshot PATH`` writes one
 mergeable snapshot PER replica (``PATH.rK``) plus the
-``tools/metrics_merge.py`` fleet view at ``PATH`` itself. Flags that
-wire a single scheduler (``--max-restarts``, ``--trace-jsonl``,
-``--flight-recorder``, ``--metrics-port``) are usage errors with
-``--replicas > 1``, as are the fleet knobs with ``--replicas 1`` —
-never silent no-ops.
+``tools/metrics_merge.py`` fleet view at ``PATH`` itself.
+
+Fleet request journeys (docs/observability.md "Fleet request
+journeys"): with ``--replicas N``, ``--trace-jsonl PATH`` opens ONE
+cross-replica trace per request (``fleet_queue → attempt[replica=k] →
+retry/backoff → hedge → failover → terminal``, with each replica's
+``queue/prefill/decode`` spans nested under its attempt) — the fleet
+plane streams to ``PATH``, each replica to ``PATH.rK``, and
+``tools/trace_explain.py`` merges them into per-request latency
+attribution that reconciles exactly with the summary and the goodput
+ledger. ``--trace-sample RATE`` head-samples the happy path
+deterministically (seeded) while tail capture promotes every
+bad-outcome journey in full; ``--metrics-port`` serves the merged fleet
+view at ``/metrics`` plus per-replica registries at ``/metrics/rK``;
+``--flight-recorder PATH`` arms one recorder per replica (``PATH.rK``,
+auto-dump on that replica's death or suspect escalation with its
+registry row and open spans) plus a fleet-plane recorder at ``PATH``.
+Only ``--max-restarts`` remains single-scheduler wiring (exit 2 with
+``--replicas > 1``), as are the fleet knobs with ``--replicas 1`` —
+never silent no-ops; ``--trace-sample`` without ``--trace-jsonl`` is
+equally inert and refused.
 
 Example::
 
@@ -89,24 +105,64 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
     a :class:`~apex_tpu.serve.fleet.FleetController`. ``slo`` (one
     parsed tracker, or None) donates its objective DECLARATIONS — each
     replica gets its own tracker instance so burn windows never alias
-    across replicas (the burn is the per-replica routing signal)."""
+    across replicas (the burn is the per-replica routing signal).
+
+    Fleet observability (PR 13): ``--trace-jsonl`` opens one
+    cross-replica journey per request (fleet file at PATH, one
+    Chrome-trace per replica at PATH.rK; ``--trace-sample`` head-samples
+    the happy path while tail capture promotes every bad outcome);
+    ``--metrics-port`` serves the merged fleet view at ``/metrics`` and
+    each replica at ``/metrics/rK``; ``--flight-recorder`` arms one
+    recorder per replica (auto-dump on that replica's death/suspect
+    transition, with its registry row as context) plus a fleet-level
+    recorder guarding the control loop."""
     import signal as signal_mod
 
     from apex_tpu.serve.engine import (Engine, EngineConfig,
                                        init_gpt2_params)
-    from apex_tpu.serve.fleet import EngineReplica, FleetController
+    from apex_tpu.serve.fleet import (EngineReplica, FleetController,
+                                      FleetTraceHarness)
     from apex_tpu.serve.scheduler import Request
 
-    want_metrics = bool(args.metrics_snapshot) or slo is not None
-    metrics_meta = None
+    replica_ids = [f"r{i}" for i in range(args.replicas)]
+    want_metrics = bool(args.metrics_snapshot) or slo is not None \
+        or args.metrics_port is not None
+    metrics_meta = registries = exporter = None
     if want_metrics:
+        from apex_tpu.monitor.export import MetricsRegistry
         from apex_tpu.utils.env import capture_provenance
 
         metrics_meta = capture_provenance()
+        registries = {rid: MetricsRegistry() for rid in replica_ids}
+        if args.metrics_port is not None:
+            # bound BEFORE the engines pay for params + compiles (the
+            # PR-10 contract): an unbindable port must fail in
+            # milliseconds with exit 2, never after trace time
+            from apex_tpu.monitor.export import FleetMetricsExporter
+
+            try:
+                exporter = FleetMetricsExporter(
+                    registries, port=args.metrics_port,
+                    meta=metrics_meta).start()
+            except OSError as e:
+                print(f"apex-tpu-serve: cannot bind --metrics-port "
+                      f"{args.metrics_port}: {e}", file=sys.stderr)
+                return 2
+            print(f"apex-tpu-serve: fleet metrics at {exporter.url} "
+                  f"(merged; per-replica at /metrics/rK)",
+                  file=sys.stderr)
+
+    harness = None
+    if args.trace_jsonl:
+        harness = FleetTraceHarness(
+            args.trace_jsonl, replica_ids,
+            sample_rate=1.0 if args.trace_sample is None
+            else args.trace_sample,
+            sample_seed=args.seed)
 
     params = init_gpt2_params(cfg, seed=args.seed)
     handles = []
-    for i in range(args.replicas):
+    for i, rid in enumerate(replica_ids):
         try:
             engine = Engine(
                 cfg, params,
@@ -118,6 +174,10 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                 seed=args.seed)
         except ValueError as e:
             print(f"apex-tpu-serve: {e}", file=sys.stderr)
+            if exporter is not None:
+                exporter.stop()
+            if harness is not None:
+                harness.close()
             return 2
         admission = metrics = None
         if args.max_queue is not None:
@@ -131,10 +191,11 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
 
             tracker = SLOTracker(slo.objectives) \
                 if slo is not None else None
-            metrics = ServeMetrics(slo=tracker)
-        handles.append(EngineReplica(f"r{i}", engine,
-                                     admission=admission,
-                                     metrics=metrics))
+            metrics = ServeMetrics(registry=registries[rid], slo=tracker)
+        handles.append(EngineReplica(
+            rid, engine, admission=admission, metrics=metrics,
+            tracer=harness.tracer_for(rid) if harness is not None
+            else None))
     # ALWAYS pre-compile in fleet mode (--aot is implied): a prefill or
     # decode compiling inside a worker's first tick blocks that
     # replica's heartbeats for the whole trace time — seconds — which
@@ -167,7 +228,20 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
         handles,
         heartbeat_ms=50.0 if args.heartbeat_ms is None
         else args.heartbeat_ms,
-        suspect_misses=20, dead_misses=40, hedge_ms=args.hedge_ms)
+        suspect_misses=20, dead_misses=40, hedge_ms=args.hedge_ms,
+        tracer=harness.fleet_tracer if harness is not None else None)
+    recorders = []
+    fleet_flight = None
+    if args.flight_recorder:
+        from apex_tpu.serve.fleet import attach_fleet_recorders
+
+        # one recorder per replica (PATH.rK: auto-dump scoped to THAT
+        # replica's death/suspect transition, with its registry row)
+        # plus the fleet-plane recorder, returned last — ONE wiring
+        # shared with apex-tpu-bench
+        recorders = attach_fleet_recorders(fleet, args.flight_recorder,
+                                           harness)
+        fleet_flight = recorders[-1]
     if args.drain_on == "SIGTERM":
         # stop admitting, shed the queued backlog retriable, finish
         # in-flight, exit cleanly — the rolling-deployment contract
@@ -183,10 +257,17 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                              deadline_ms=args.deadline_ms,
                              tenant=tenant))
     try:
+        import contextlib
+
         # liveness bound scaled to the workload: a large --requests run
-        # is long, not wedged
-        stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(prompts)))
+        # is long, not wedged. A fatal control-loop exception leaves the
+        # fleet-plane postmortem before propagating.
+        with (fleet_flight.guard("fleet") if fleet_flight is not None
+              else contextlib.nullcontext()):
+            stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(prompts)))
     finally:
+        if exporter is not None:
+            exporter.stop()
         if want_metrics and args.metrics_snapshot:
             # one mergeable snapshot PER replica (PATH.rK — what a real
             # fleet's ranks each write) plus the metrics_merge fleet
@@ -204,6 +285,11 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                 docs.append(doc)
             atomic_write_json(args.metrics_snapshot,
                               merge_snapshots(docs))
+        for fr in recorders:
+            fr.detach()
+        if harness is not None:
+            # finalize PATH + every PATH.rK into strict JSON
+            harness.close()
         if tel is not None:
             tel.close()
     for rec in stats.requests:
@@ -213,6 +299,10 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                                  for h in handles],
              "prefill_compiles": [h.engine.prefill_traces
                                   for h in handles]}
+    if harness is not None:
+        # sampling provenance: how many journeys streamed, how many the
+        # tail capture promoted, how many happy-path ones were dropped
+        final["trace"] = harness.stats()
     print(json.dumps(final, sort_keys=True))
     return 0
 
@@ -326,7 +416,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--trace-jsonl", default=None,
                     help="write per-request span traces (queue/prefill/"
                          "decode/complete) as Perfetto-loadable "
-                         "Chrome-trace JSON")
+                         "Chrome-trace JSON; with --replicas N the "
+                         "fleet journey lands here and each replica's "
+                         "trace at PATH.rK (tools/trace_explain.py "
+                         "merges + reconciles them)")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    metavar="RATE",
+                    help="deterministic head sampling over request "
+                         "journeys (seeded by --seed): only RATE of "
+                         "happy-path journeys reach the trace file, "
+                         "while every bad-outcome journey (deadline/"
+                         "evict/reject/failover/hedge, or terminal "
+                         "inside an SLO breach) is promoted in full — "
+                         "the slow tail is always captured (needs "
+                         "--trace-jsonl; default: trace everything)")
     ap.add_argument("--flight-recorder", default=None,
                     help="crash-time flight-recorder dump path: on "
                          "preemption, watchdog escalation, or a fatal "
@@ -376,25 +479,32 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"apex-tpu-serve: --heartbeat-ms "
                   f"{args.heartbeat_ms:g} must be > 0", file=sys.stderr)
             return 2
-        single_only = [
-            (args.max_restarts > 0, "--max-restarts",
-             "the per-replica warm-restart supervisor wires ONE "
-             "scheduler; the fleet recovers by failover re-dispatch"),
-            (args.trace_jsonl is not None, "--trace-jsonl",
-             "per-request span tracing is single-scheduler wiring"),
-            (args.flight_recorder is not None, "--flight-recorder",
-             "the recorder guards ServeScheduler.run(), which fleet "
-             "workers never call — it would be armed but inert"),
-            (args.metrics_port is not None, "--metrics-port",
-             "the pull endpoint serves ONE registry; fleet metrics are "
-             "per-replica snapshots folded by tools/metrics_merge.py"),
-        ]
-        for cond, flag, why in single_only:
-            if cond:
-                print(f"apex-tpu-serve: {flag} cannot apply with "
-                      f"--replicas {args.replicas}: {why}",
-                      file=sys.stderr)
-                return 2
+        # --trace-jsonl / --flight-recorder / --metrics-port are fleet
+        # citizens since PR 13 (cross-replica journeys, per-replica
+        # postmortems, the merged pull endpoint); only the warm-restart
+        # supervisor still wires exactly ONE scheduler
+        if args.max_restarts > 0:
+            print(f"apex-tpu-serve: --max-restarts cannot apply with "
+                  f"--replicas {args.replicas}: the per-replica "
+                  f"warm-restart supervisor wires ONE scheduler; the "
+                  f"fleet recovers by failover re-dispatch",
+                  file=sys.stderr)
+            return 2
+
+    # trace sampling is a property OF the trace file: without
+    # --trace-jsonl there is nothing to sample (and silently ignoring
+    # the rate would leave the user believing tail capture is armed)
+    if args.trace_sample is not None:
+        if not args.trace_jsonl:
+            print("apex-tpu-serve: --trace-sample needs --trace-jsonl "
+                  "(it decides which journeys reach that file)",
+                  file=sys.stderr)
+            return 2
+        if not 0.0 < args.trace_sample <= 1.0:
+            print(f"apex-tpu-serve: --trace-sample {args.trace_sample:g} "
+                  f"must be in (0, 1] (1 = trace everything)",
+                  file=sys.stderr)
+            return 2
 
     if args.tenants > 0 and args.stdin:
         # before the stdin read: stdin lines carry no tenant identity to
@@ -522,14 +632,31 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # one Telemetry owns the whole observability lifecycle: event mirror
     # (--telemetry-jsonl), span tracer install/restore + Chrome-trace
-    # export (--trace-jsonl) — same wiring as apex-tpu-bench
-    tel = flight = mem = None
-    if args.telemetry_jsonl or args.trace_jsonl:
-        from apex_tpu.monitor import Telemetry
+    # export (--trace-jsonl) — same wiring as apex-tpu-bench. With
+    # --trace-sample, the Chrome-trace export routes through the
+    # tail-capture router instead (head sampling + bad-outcome
+    # promotion); without it, today's stream-everything path is
+    # untouched (rate=1 IS that behavior)
+    tel = flight = mem = router = None
+    if args.trace_jsonl and args.trace_sample is not None:
+        from apex_tpu.monitor.trace import (ChromeTraceWriter,
+                                            TailCaptureRouter, Tracer)
 
-        tel = Telemetry(args.telemetry_jsonl,
-                        trace_jsonl=args.trace_jsonl)
-    tracer = tel.tracer if tel is not None else None
+        tracer = Tracer()
+        router = TailCaptureRouter(
+            {"": ChromeTraceWriter(args.trace_jsonl, subscribe=False)},
+            sample_rate=args.trace_sample, sample_seed=args.seed)
+        if args.telemetry_jsonl:
+            from apex_tpu.monitor import Telemetry
+
+            tel = Telemetry(args.telemetry_jsonl)
+    else:
+        if args.telemetry_jsonl or args.trace_jsonl:
+            from apex_tpu.monitor import Telemetry
+
+            tel = Telemetry(args.telemetry_jsonl,
+                            trace_jsonl=args.trace_jsonl)
+        tracer = tel.tracer if tel is not None else None
     if args.trace_jsonl:
         from apex_tpu.monitor.memory import MemoryAccountant
 
@@ -588,6 +715,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                            meta=metrics_meta)
         if flight is not None:
             flight.detach()
+        if router is not None:
+            router.close()
         if tel is not None:
             tel.close()
 
@@ -596,6 +725,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     final = {"summary": stats.summary(),
              "decode_compiles": engine.decode_traces,
              "prefill_compiles": engine.prefill_traces}
+    if router is not None:
+        final["trace"] = {"sample_rate": router.sampler.rate,
+                          "sample_seed": router.sampler.seed,
+                          **router.stats()}
     if metrics is not None:
         # live totals + SLO state ride the same final line the exact
         # summary does: the two views must reconcile (tier-1 asserts)

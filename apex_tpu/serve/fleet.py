@@ -60,6 +60,27 @@ owns:
   fleet-wide, greedy completions bit-identical to the no-fault fleet,
   and zero decode retraces on every surviving replica.
 
+**Request journeys** (PR 13). With a :class:`~apex_tpu.monitor.trace.
+Tracer` armed (``tracer=``), the controller opens ONE fleet-level trace
+per request — ``journey`` root with ``fleet_queue → attempt[replica=k]
+→ backoff → hedge → failover → terminal`` children — and propagates the
+trace id + attempt span id into each replica attempt
+(:attr:`~apex_tpu.serve.scheduler.Request.trace_id` /
+``trace_parent``), so the replica scheduler's existing
+``queue/prefill/decode`` spans nest as children of the attempt. Every
+fleet span is stamped from the SAME clock reads the summary and the
+``serve_failover`` events use, and carries the rounded
+``seconds``/``ttft_s``/``latency_s`` values as attrs — span durations
+reconcile EXACTLY with TTFT/latency/failover accounting
+(``tools/trace_explain.py`` exits 1 when they don't), and decode still
+compiles once per replica with tracing armed. The journey root closes
+LAST, after every bus event for the request — the tail-capture router's
+fallback decision point. :class:`FleetTraceHarness` wires the whole
+surface for the CLIs: per-replica Chrome-trace files at ``PATH.rK``,
+the fleet-plane file at ``PATH``, and the
+:class:`~apex_tpu.monitor.trace.TailCaptureRouter` head-sampling +
+tail-capture policy across them.
+
 **Threading contract.** Each replica's worker thread touches only its
 own scheduler (which serializes under its own lock) and the registry
 (every row mutation under the registry lock — apexlint APX002 keeps the
@@ -68,13 +89,16 @@ discipline). All :class:`FleetController` methods — ``submit``, ``run``,
 thread; the controller's own tables need no lock because no worker ever
 writes them (workers signal through the registry and their scheduler's
 ``done`` list, which the control thread harvests under the scheduler
-lock). Known coupling: ``load()``/``done_since()`` contend on the
-scheduler lock, which ``step()`` holds across the whole tick — a pump
-iteration can therefore wait out the slowest replica's in-flight tick
-before it routes or hedges. Lock-free worker-published snapshots (the
-``partitioned``/``crashed`` rebind idiom) would decouple it; on the
-multi-second CPU-contention tail this bounds hedge/failover REACTION
-latency, never correctness.
+lock). The pump's per-iteration probes are **lock-free**: each worker
+publishes a ``(load, done_count)`` snapshot after every tick (one tuple
+rebind — the ``partitioned``/``crashed`` APX002-legal snapshot idiom,
+PR 11's documented follow-up), and the control thread refreshes it
+itself after its own submits/pops, so routing and the harvest gate
+never contend with the scheduler lock ``step()`` holds across a tick —
+the hedge/failover reaction latency no longer waits out the slowest
+replica's in-flight tick. Only an actual harvest (new terminal records
+exist) or an explicit drain/restart takes a scheduler lock from the
+control thread.
 
 **Metrics.** Give each :class:`EngineReplica` its own
 :class:`~apex_tpu.serve.metrics.ServeMetrics`: per-replica snapshots fold
@@ -92,6 +116,15 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Set
 
 from apex_tpu.monitor.export import percentile
+from apex_tpu.monitor.flight import FlightRecorder
+# module-level on purpose (flight too): a function-local import inside
+# FleetTraceHarness would RE-import monitor.trace after
+# test_chip_worker's sys.modules purge, binding a fresh module whose
+# bus the collection-time scheduler modules never publish to — the
+# tail-capture router would then miss every lifecycle event (the
+# test_serve_resilience subscribe-at-collection precedent)
+from apex_tpu.monitor.trace import (ChromeTraceWriter, TailCaptureRouter,
+                                    Tracer)
 from apex_tpu.serve.scheduler import Request, ServeScheduler
 from apex_tpu.utils.logging import publish_event
 
@@ -211,6 +244,16 @@ class ReplicaRegistry:
             return {rid: row["state"]
                     for rid, row in self._rows.items()}
 
+    def row(self, replica_id: str) -> Dict[str, Any]:
+        """A copy of one replica's registry row plus its beat age — the
+        context a per-replica flight recorder stamps into a death
+        postmortem (state, last heartbeat, how long it was silent)."""
+        with self._lock:
+            row = dict(self._rows[str(replica_id)])
+        row["replica"] = str(replica_id)
+        row["age_s"] = round(self.clock() - row["last_beat"], 6)
+        return row
+
     def set_state(self, replica_id: str, state: str, *,
                   beat: bool = False) -> None:
         """Explicit lifecycle transition (drain / drained / restart) from
@@ -246,6 +289,12 @@ class EngineReplica:
         self.tick = 0
         self.partitioned = False
         self.crashed = False
+        # lock-free (load, done_count) snapshot: the worker rebinds it
+        # after every tick, the control thread after its own submits and
+        # pops — one tuple rebind, the APX002-legal snapshot idiom — so
+        # the pump's routing/harvest probes never contend with the
+        # scheduler lock step() holds across a whole tick
+        self._progress = (0, 0)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._registry: Optional[ReplicaRegistry] = None
@@ -260,6 +309,7 @@ class EngineReplica:
     def start(self, registry: ReplicaRegistry, injector=None) -> None:
         self._registry = registry
         self._injector = injector
+        self.publish_progress()
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._worker, name=f"replica-{self.replica_id}",
@@ -288,12 +338,30 @@ class EngineReplica:
         self.tick = 0
         self.partitioned = False
         self.crashed = False
+        self.publish_progress()
         if self._registry is not None:
             self.start(self._registry, self._injector)
 
+    def publish_progress(self) -> None:
+        """Refresh the lock-free progress snapshot (one scheduler-lock
+        acquisition, one tuple rebind). The worker calls it each tick;
+        the control thread calls it right after its own scheduler
+        mutations (submit / pop_queued / abort), so :meth:`load` is
+        exact whenever the controller just changed it and at most one
+        tick stale otherwise."""
+        self._progress = self.scheduler.progress()
+
     def load(self) -> int:
-        """Queued + in-slot requests — the router's load signal."""
-        return self.scheduler.load()
+        """Queued + in-slot requests — the router's load signal. Reads
+        the published snapshot, never the scheduler lock."""
+        return self._progress[0]
+
+    @property
+    def done_count(self) -> int:
+        """Published terminal-record count — the harvest gate: the
+        controller takes the scheduler lock only when this moved past
+        its cursor."""
+        return self._progress[1]
 
     def burn_short_max(self) -> float:
         """The replica's worst SLO short-window burn rate (0.0 with no
@@ -330,6 +398,7 @@ class EngineReplica:
                 if not self.partitioned:
                     self._registry.heartbeat(self.replica_id)
                 busy = self.scheduler.step()
+                self.publish_progress()
                 if not busy:
                     time.sleep(self.idle_sleep_s)
         except SimulatedCrash:
@@ -345,7 +414,8 @@ class _FleetRequest:
     record (first terminal of a live attempt wins)."""
 
     __slots__ = ("spec", "attempts", "attempt_t", "record", "dispatch_t",
-                 "hedged", "retries", "next_dispatch_t")
+                 "hedged", "retries", "next_dispatch_t", "spans",
+                 "attempt_seq")
 
     def __init__(self, spec: Request):
         self.spec = spec
@@ -356,6 +426,10 @@ class _FleetRequest:
         self.hedged = False
         self.retries = 0
         self.next_dispatch_t = 0.0
+        # journey spans (tracer armed only): "root", "fleet_queue",
+        # "backoff", and ("attempt", replica_id) entries
+        self.spans: Optional[Dict[Any, Any]] = None
+        self.attempt_seq = 0
 
 
 @dataclasses.dataclass
@@ -441,7 +515,8 @@ class FleetController:
                  retry_backoff_factor: float = 2.0,
                  max_retry_backoff_s: float = 0.5,
                  shed_burn_factor: float = 2.0,
-                 fault_injector=None, clock=time.perf_counter):
+                 fault_injector=None, tracer=None,
+                 clock=time.perf_counter):
         if not replicas:
             raise ValueError("FleetController needs at least one replica")
         ids = [h.replica_id for h in replicas]
@@ -469,6 +544,10 @@ class FleetController:
         self.max_retry_backoff_s = float(max_retry_backoff_s)
         self.shed_burn_factor = float(shed_burn_factor)
         self.injector = fault_injector
+        # fleet-level request journeys: one trace per submitted request,
+        # stamped from the same clock reads the accounting uses
+        self.tracer = tracer if tracer is not None and tracer.enabled \
+            else None
         self._clock = clock
         self._pump_interval_s = min(0.003, heartbeat_ms / 4e3)
         self._requests: Dict[Any, _FleetRequest] = {}
@@ -513,6 +592,19 @@ class FleetController:
         freq = _FleetRequest(spec)
         self._requests[spec.request_id] = freq
         now = self._clock()
+        if self.tracer is not None:
+            # the journey roots at the controller's OWN submit stamp —
+            # the same `now` every dispatch/backoff computation below
+            # measures from, so fleet span durations and the routing
+            # accounting are the same numbers
+            root = self.tracer.begin(
+                "journey", trace_id=f"journey:{spec.request_id}",
+                t0=now, request_id=str(spec.request_id),
+                prompt_tokens=len(spec.tokens))
+            freq.spans = {
+                "root": root,
+                "fleet_queue": self.tracer.begin("fleet_queue",
+                                                 parent=root, t0=now)}
         handle = self._route()
         if handle is None:
             freq.next_dispatch_t = now
@@ -559,6 +651,23 @@ class FleetController:
                       max_new_tokens=spec.max_new_tokens,
                       eos_id=spec.eos_id, deadline_ms=spec.deadline_ms,
                       priority=spec.priority, tenant=spec.tenant)
+        sp = freq.spans
+        if sp is not None:
+            # whichever wait preceded this dispatch ends now (first
+            # dispatch: fleet_queue; a retry: its backoff span)
+            for key in ("fleet_queue", "backoff"):
+                waited = sp.pop(key, None)
+                if waited is not None:
+                    self.tracer.end(waited, t1=now)
+            freq.attempt_seq += 1
+            att_span = self.tracer.begin(
+                "attempt", parent=sp["root"], t0=now,
+                replica=handle.replica_id, attempt=freq.attempt_seq)
+            sp[("attempt", handle.replica_id)] = att_span
+            # propagate: the replica scheduler's request trace nests
+            # under this attempt span, in the SAME journey trace
+            att.trace_id = sp["root"].trace_id
+            att.trace_parent = att_span.span_id
         freq.attempts[handle.replica_id] = att
         freq.attempt_t[handle.replica_id] = now
         freq.dispatch_t = now
@@ -567,6 +676,7 @@ class FleetController:
         # record in the replica's done list — the harvest/retry path
         # owns it from there
         handle.scheduler.submit(att)
+        handle.publish_progress()
 
     # ------------------------------------------------------ control loop
     def start(self) -> None:
@@ -646,6 +756,11 @@ class FleetController:
                 # heals (and then lose first-terminal-wins if the
                 # router already settled the request elsewhere)
                 continue
+            if handle.done_count == handle.done_seen:
+                # lock-free gate: the published snapshot says nothing
+                # new is terminal — skip the scheduler lock entirely
+                # (it may be held across a multi-second contended tick)
+                continue
             done, handle.done_seen = handle.scheduler.done_since(
                 handle.done_seen)
             for req in done:
@@ -663,6 +778,7 @@ class FleetController:
             # after death) — its record must never settle the request
             return
         del freq.attempts[handle.replica_id]
+        done_t = req.done_t if req.done_t is not None else now
         if req.state == "rejected":
             # a shed copy must never settle a request another replica
             # is actively serving: with a hedge copy still live, that
@@ -670,6 +786,8 @@ class FleetController:
             # live copy is later rejected too, attempts is empty and
             # the normal retry/terminal path below owns it)
             if freq.attempts:
+                self._end_attempt(freq, handle.replica_id, t1=done_t,
+                                  status="cancelled", reason="rejected")
                 return
             if self._retryable(freq):
                 freq.retries += 1
@@ -679,20 +797,31 @@ class FleetController:
                     * self.retry_backoff_factor ** (freq.retries - 1),
                     self.max_retry_backoff_s)
                 freq.next_dispatch_t = now + backoff
+                self._end_attempt(freq, handle.replica_id, t1=done_t,
+                                  status="cancelled", reason="rejected")
+                if freq.spans is not None:
+                    # the wait until re-dispatch: closed by the next
+                    # _submit_attempt (its `now` — the same stamp
+                    # attempt_t records)
+                    freq.spans["backoff"] = self.tracer.begin(
+                        "backoff", parent=freq.spans["root"], t0=now,
+                        retry=freq.retries,
+                        backoff_s=round(backoff, 6))
                 self._pending.append(freq)
                 return
-        self._accept(freq, handle.replica_id, req)
+        self._accept(freq, handle.replica_id, req, now)
 
     def _retryable(self, freq: _FleetRequest) -> bool:
         return freq.retries < self.max_retries \
             and self._route() is not None
 
     def _accept(self, freq: _FleetRequest, replica_id: str,
-                req: Request) -> None:
+                req: Request, now: float) -> None:
         """First terminal of a live attempt wins: record it, abort every
         other live attempt (reachable replicas only — an unreachable
         one's duplicate is dropped at harvest by the attempt-identity
-        rule)."""
+        rule), then close the journey — terminal + root spans last,
+        after every lifecycle event the settle published."""
         record = dict(req.record())
         record["replica"] = replica_id
         freq.record = record
@@ -700,7 +829,56 @@ class FleetController:
             h = self._by_id[rid]
             if h.reachable:
                 h.scheduler.abort(att.request_id)
+                h.publish_progress()
+            self._end_attempt(freq, rid, t1=now, status="cancelled",
+                              reason="superseded")
         freq.attempts.clear()
+        done_t = req.done_t if req.done_t is not None else now
+        self._end_attempt(
+            freq, replica_id, t1=done_t,
+            status="ok" if req.state == "completed" else "cancelled")
+        self._close_journey(freq, t1=done_t, record=record)
+
+    # ------------------------------------------------------ journey spans
+    def _end_attempt(self, freq: _FleetRequest, replica_id: str, *,
+                     t1: float, status: str, **attrs: Any) -> None:
+        sp = freq.spans
+        if sp is None:
+            return
+        span = sp.pop(("attempt", replica_id), None)
+        if span is not None:
+            self.tracer.end(span, t1=t1, status=status, **attrs)
+
+    def _close_journey(self, freq: _FleetRequest, *, t1: float,
+                       record: Dict[str, Any]) -> None:
+        """Terminal marker + root close, carrying the record's EXACT
+        rounded ttft/latency values as attrs (what trace_explain
+        reconciles bit-for-bit against the summary). Runs exactly once,
+        LAST — after every bus event for this request — so the
+        tail-capture router's fallback decision sees a settled world."""
+        sp = freq.spans
+        if sp is None:
+            return
+        freq.spans = None
+        # anything still open (a dead replica's attempt that never
+        # settled, a backoff that never re-dispatched) ends here
+        for key, span in list(sp.items()):
+            if key != "root":
+                self.tracer.end(span, t1=t1, status="cancelled")
+        attrs = {"state": record["state"],
+                 "finish_reason": record.get("finish_reason"),
+                 "replica": record.get("replica"),
+                 "new_tokens": record.get("new_tokens", 0)}
+        for key in ("ttft_s", "latency_s"):
+            if record.get(key) is not None:
+                attrs[key] = record[key]
+        term = self.tracer.begin("terminal", parent=sp["root"], t0=t1,
+                                 **attrs)
+        self.tracer.end(term, t1=t1)
+        self.tracer.end(
+            sp["root"], t1=t1,
+            status="ok" if record["state"] == "completed"
+            else "cancelled", **attrs)
 
     # --------------------------------------------------------- failover
     def _failover(self, replica_id: str, now: float) -> None:
@@ -713,7 +891,11 @@ class FleetController:
             att = freq.attempts.pop(replica_id, None)
             if att is None or freq.record is not None:
                 continue
-            lost_s = max(now - freq.attempt_t.get(replica_id, now), 0.0)
+            lost_t0 = freq.attempt_t.get(replica_id, now)
+            lost_s = max(now - lost_t0, 0.0)
+            seconds = round(lost_s, 6)
+            self._end_attempt(freq, replica_id, t1=now, status="error",
+                              cause="replica_dead", seconds=seconds)
             if freq.attempts:
                 continue    # a hedge copy already runs elsewhere
             self.failovers += 1
@@ -723,7 +905,18 @@ class FleetController:
                 request_id=freq.spec.request_id,
                 from_replica=replica_id,
                 to_replica=target.replica_id if target else None,
-                cause="replica_dead", seconds=round(lost_s, 6))
+                cause="replica_dead", seconds=seconds)
+            if freq.spans is not None:
+                # the failover gap span covers EXACTLY the lost attempt
+                # window, and its ``seconds`` attr is the SAME rounded
+                # value the event (and so the goodput ledger) carries —
+                # the reconciliation in tools/trace_explain.py is exact
+                fo = self.tracer.begin(
+                    "failover", parent=freq.spans["root"], t0=lost_t0,
+                    from_replica=replica_id,
+                    to_replica=target.replica_id if target else None,
+                    cause="replica_dead", seconds=seconds)
+                self.tracer.end(fo, t1=now)
             if target is not None:
                 self._submit_attempt(freq, target, now)
             else:
@@ -758,6 +951,9 @@ class FleetController:
             "prompt_tokens": len(freq.spec.tokens), "new_tokens": 0,
             "generated": [], "replica": None}
         freq.attempts.clear()
+        # total fleet loss publishes no lifecycle event — the journey
+        # root close below IS the tail-capture router's decision point
+        self._close_journey(freq, t1=now, record=freq.record)
 
     def _shed_queued_for_drain(self, now: float) -> None:
         """The fleet-wide drain sweep (one per :meth:`begin_drain`):
@@ -774,7 +970,11 @@ class FleetController:
                 h = self._by_id[rid]
                 if h.reachable and \
                         h.scheduler.pop_queued(att.request_id) is not None:
+                    h.publish_progress()
                     del freq.attempts[rid]
+                    self._end_attempt(freq, rid, t1=now,
+                                      status="cancelled",
+                                      reason="draining")
             if freq.attempts:
                 continue    # admitted (or unreachable): finishes there
             freq.record = {
@@ -786,6 +986,7 @@ class FleetController:
                           request_id=freq.spec.request_id,
                           reason="draining", retriable=True,
                           seconds=0.0, queue_depth=0)
+            self._close_journey(freq, t1=now, record=freq.record)
         self._pending = [f for f in self._pending if f.record is None]
 
     # ---------------------------------------------------------- hedging
@@ -804,11 +1005,19 @@ class FleetController:
                 continue
             freq.hedged = True      # at most ONE hedge per request
             self.hedges_fired += 1
+            waited_ms = round((now - freq.dispatch_t) * 1e3, 3)
             publish_event("serve_hedge_fired",
                           request_id=freq.spec.request_id,
                           primary=primary, hedge=target.replica_id,
-                          waited_ms=round(
-                              (now - freq.dispatch_t) * 1e3, 3))
+                          waited_ms=waited_ms)
+            if freq.spans is not None:
+                # instant marker: the race opens here; the two attempt
+                # spans racing after it ARE the hedge margin
+                h = self.tracer.begin(
+                    "hedge", parent=freq.spans["root"], t0=now,
+                    primary=primary, hedge=target.replica_id,
+                    waited_ms=waited_ms)
+                self.tracer.end(h, t1=now)
             self._submit_attempt(freq, target, now)
 
     # --------------------------------------------- drain / rolling restart
@@ -831,17 +1040,30 @@ class FleetController:
             popped = handle.scheduler.pop_queued(att.request_id)
             if popped is None:
                 continue    # already in a slot: finishes where it is
+            handle.publish_progress()
             del freq.attempts[handle.replica_id]
             migrated += 1
             self.migrations += 1
+            lost_t0 = freq.attempt_t.get(handle.replica_id, now)
+            seconds = round(max(now - lost_t0, 0.0), 6)
+            self._end_attempt(freq, handle.replica_id, t1=now,
+                              status="cancelled", cause="drain",
+                              seconds=seconds)
             target = self._route(exclude=(handle.replica_id,))
             publish_event(
                 "serve_failover", request_id=freq.spec.request_id,
                 from_replica=handle.replica_id,
                 to_replica=target.replica_id if target else None,
-                cause="drain",
-                seconds=round(max(now - freq.attempt_t.get(
-                    handle.replica_id, now), 0.0), 6))
+                cause="drain", seconds=seconds)
+            if freq.spans is not None:
+                # same contract as the death path: span window == the
+                # migrated wait, seconds attr == the event's value
+                fo = self.tracer.begin(
+                    "failover", parent=freq.spans["root"], t0=lost_t0,
+                    from_replica=handle.replica_id,
+                    to_replica=target.replica_id if target else None,
+                    cause="drain", seconds=seconds)
+                self.tracer.end(fo, t1=now)
             if target is not None:
                 self._submit_attempt(freq, target, now)
             else:
@@ -896,6 +1118,7 @@ class FleetController:
             handle.engine.reset()
             handle.crashed = False
             handle.partitioned = False
+            handle.publish_progress()
         self.registry.set_state(handle.replica_id, REPLICA_HEALTHY,
                                 beat=True)
         self.replica_restarts += 1
@@ -960,3 +1183,104 @@ class FleetController:
             replica_restarted=self.replica_restarts,
             attempts=attempts, per_replica=per_replica,
             decode_step_s=pooled_steps, wall_s=wall)
+
+
+# --------------------------------------------------------------------------
+# --trace-jsonl fleet wiring (shared by apex-tpu-serve and apex-tpu-bench)
+# --------------------------------------------------------------------------
+
+class FleetTraceHarness:
+    """One object owning the whole fleet tracing surface: a fleet-plane
+    :class:`~apex_tpu.monitor.trace.Tracer` (track ``fleet``) streaming
+    to ``PATH``, one tracer per replica (track ``rK``) streaming to
+    ``PATH.rK``, and a :class:`~apex_tpu.monitor.trace.TailCaptureRouter`
+    applying the seeded head-sampling + tail-capture policy across all of
+    them (``sample_rate=1`` — the default — streams every journey, the
+    pre-PR-13 behavior).
+
+    Usage::
+
+        harness = FleetTraceHarness(path, [h.replica_id for h in handles],
+                                    sample_rate=0.1, sample_seed=seed)
+        fleet = FleetController(handles, tracer=harness.fleet_tracer, ...)
+        # EngineReplica(..., tracer=harness.tracer_for(rid)) per replica
+        try:
+            fleet.run()
+        finally:
+            harness.close()    # finalize every trace file
+
+    ``tools/trace_explain.py PATH PATH.r0 ...`` merges the files back
+    into per-request attribution and verifies the reconciliation.
+    """
+
+    def __init__(self, path: str, replica_ids: Sequence[str], *,
+                 sample_rate: float = 1.0, sample_seed: int = 0,
+                 ring_spans: int = 256):
+        self.path = path
+        self.fleet_tracer = Tracer(tags={"track": "fleet"})
+        self.replica_tracers = {
+            str(rid): Tracer(tags={"track": str(rid)})
+            for rid in replica_ids}
+        # dict order matters: the fleet writer is first, so untracked
+        # spans (none in practice) default to the fleet file
+        writers = {"fleet": ChromeTraceWriter(path, subscribe=False)}
+        for rid in self.replica_tracers:
+            writers[rid] = ChromeTraceWriter(f"{path}.{rid}",
+                                             subscribe=False)
+        self.router = TailCaptureRouter(writers, sample_rate=sample_rate,
+                                        sample_seed=sample_seed,
+                                        ring_spans=ring_spans)
+
+    def tracer_for(self, replica_id: str):
+        return self.replica_tracers[str(replica_id)]
+
+    @property
+    def paths(self) -> List[str]:
+        return [self.path] + [f"{self.path}.{rid}"
+                              for rid in self.replica_tracers]
+
+    def stats(self) -> Dict[str, Any]:
+        """Sampling/promotion provenance for the CLI summary and the
+        bench entry (``trace_promoted`` gates lower-is-better)."""
+        return {"sample_rate": self.router.sampler.rate,
+                "sample_seed": self.router.sampler.seed,
+                **self.router.stats()}
+
+    def close(self) -> None:
+        self.router.close()
+
+    def __enter__(self) -> "FleetTraceHarness":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def attach_fleet_recorders(fleet: FleetController, path: str,
+                           harness: Optional[FleetTraceHarness] = None
+                           ) -> List[FlightRecorder]:
+    """``--flight-recorder`` fleet wiring, shared by ``apex-tpu-serve``
+    and ``apex-tpu-bench --serve`` (one spelling — the two CLIs'
+    postmortems can never diverge): one recorder per replica at
+    ``PATH.rK``, auto-dump scoped (``trigger_filter``) to THAT replica's
+    death/suspect transition and carrying its registry row
+    (``context_fn``) plus its tracer's open spans; plus the fleet-plane
+    recorder at ``PATH``, returned LAST — wrap the control loop in its
+    ``guard()`` (a fatal controller error has no bus record to trigger
+    on). The caller detaches every returned recorder in its teardown."""
+    recorders: List[FlightRecorder] = []
+    for h in fleet.handles:
+        rid = h.replica_id
+        recorders.append(FlightRecorder(
+            f"{path}.{rid}",
+            tracer=harness.tracer_for(rid) if harness is not None
+            else None,
+            trigger_filter=lambda rec, rid=rid:
+            rec.get("replica") in (None, rid),
+            context_fn=lambda rid=rid:
+            fleet.registry.row(rid)).attach())
+    recorders.append(FlightRecorder(
+        path,
+        tracer=harness.fleet_tracer if harness is not None
+        else None).attach())
+    return recorders

@@ -107,6 +107,13 @@ class Request:
     # admission/latency/SLO series are recorded per tenant with bounded
     # cardinality; None lands under the "default" tenant
     tenant: Optional[str] = None
+    # cross-replica trace propagation (serve.fleet): the controller's
+    # journey trace id + the attempt span id this request should nest
+    # under, so the replica's queue/prefill/decode spans link as children
+    # of the fleet-level attempt. None (the default) keeps the PR-6
+    # behavior: one standalone "request:<id>" trace per request
+    trace_id: Optional[str] = None
+    trace_parent: Optional[int] = None
 
     # filled in by the scheduler
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -321,6 +328,27 @@ class ServeScheduler:
                 # rejected over everything that ASKED, so a
                 # reject-at-submit must land in the submitted total too
                 self.metrics.on_submit(req)
+            if self.tracer is not None:
+                # one trace per request, rooted at submit; span stamps
+                # reuse the scheduler's own clock reads so trace durations
+                # and the TTFT/latency accounting are the same numbers.
+                # A fleet-dispatched request carries the controller's
+                # journey trace id + attempt span id: this root becomes a
+                # child in the cross-replica journey instead of a
+                # standalone trace. Opened BEFORE the admission verdict:
+                # a reject-at-submit is a bad outcome the tail-capture
+                # router must be able to promote — a journey with zero
+                # spans would be invisible to the trace file
+                root = self.tracer.begin(
+                    "request",
+                    trace_id=req.trace_id or f"request:{req.request_id}",
+                    parent_id=req.trace_parent,
+                    t0=req.submit_t, request_id=str(req.request_id),
+                    prompt_tokens=len(req.tokens))
+                self._req_spans[req] = {
+                    "root": root,
+                    "queue": self.tracer.begin("queue", parent=root,
+                                               t0=req.submit_t)}
             if self.admission is not None:
                 verdict, victim = self.admission.on_submit(self.queue, req)
                 if verdict == "reject":
@@ -338,18 +366,6 @@ class ServeScheduler:
                                  seconds=max(req.submit_t
                                              - victim.submit_t
                                              - victim.wait_charged, 0.0))
-            if self.tracer is not None:
-                # one trace per request, rooted at submit; span stamps
-                # reuse the scheduler's own clock reads so trace durations
-                # and the TTFT/latency accounting are the same numbers
-                root = self.tracer.begin(
-                    "request", trace_id=f"request:{req.request_id}",
-                    t0=req.submit_t, request_id=str(req.request_id),
-                    prompt_tokens=len(req.tokens))
-                self._req_spans[req] = {
-                    "root": root,
-                    "queue": self.tracer.begin("queue", parent=root,
-                                               t0=req.submit_t)}
             self.queue.append(req)
         return True
 
@@ -530,9 +546,18 @@ class ServeScheduler:
         mark = self.tracer.begin(marker, parent=sp["root"], t0=t1,
                                  reason=reason)
         self.tracer.end(mark, t1=t1)
+        # the EXACT rounded accounting values ride the root close as
+        # attrs (the same numbers record()/summary() carry), so
+        # tools/trace_explain.py reconciles bit-for-bit instead of
+        # re-deriving them from microsecond-rounded stamps
+        extra: Dict[str, Any] = {}
+        if req.ttft_s is not None:
+            extra["ttft_s"] = round(req.ttft_s, 6)
+        if req.latency_s is not None:
+            extra["latency_s"] = round(req.latency_s, 6)
         self.tracer.end(sp["root"], t1=t1, status=status,
-                        finish_reason=reason,
-                        new_tokens=len(req.generated))
+                        state=req.state, finish_reason=reason,
+                        new_tokens=len(req.generated), **extra)
 
     def _finish(self, req: Request, reason: str) -> None:
         # caller holds self._lock (_accept_token)
@@ -576,6 +601,17 @@ class ServeScheduler:
         with self._lock:
             return len(self.queue) + sum(r is not None
                                          for r in self.slots)
+
+    def progress(self):
+        """``(load, done_count)`` under ONE lock acquisition — the fleet
+        worker reads this between ticks and publishes it as a lock-free
+        snapshot (:attr:`EngineReplica` plain-rebind), so the
+        controller's per-pump probes never contend with the scheduler
+        lock :meth:`step` holds across a whole tick."""
+        with self._lock:
+            return (len(self.queue) + sum(r is not None
+                                          for r in self.slots),
+                    len(self.done))
 
     def done_since(self, cursor: int):
         """Terminal requests appended to :attr:`done` since ``cursor``,

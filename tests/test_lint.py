@@ -556,6 +556,39 @@ def test_apx005_covers_fleet_heartbeat_deadline(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx005_covers_fleet_journey_span_stamps(tmp_path):
+    """PR-13 coverage proof: a fleet journey span whose failover window
+    is computed from ``time.time()`` stamps fires APX005 (an NTP step
+    would skew the span's ``seconds`` against the monotonic ledger cause
+    and break the exact trace/summary reconciliation); the
+    scheduler-clock spelling the real controller stamps spans with stays
+    quiet."""
+    _fixture(tmp_path, "apex_tpu/serve/fleet.py", """\
+        import time
+
+        def close_failover_span(span, attempt_t):
+            now = time.time()
+            span["seconds"] = now - attempt_t
+            span["t1"] = now
+            return span
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert len(active) == 1 and "monotonic" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "serve" / "fleet.py"
+    good.write_text(textwrap.dedent("""\
+        import time
+
+        def close_failover_span(span, attempt_t):
+            now = time.perf_counter()
+            span["seconds"] = now - attempt_t
+            span["t1"] = now
+            return span
+        """))
+    active, _ = _run(tmp_path, "APX005")
+    assert not active, [v.format() for v in active]
+
+
 def test_apx002_covers_fleet_registry_heartbeat_thread(tmp_path):
     """PR-11 coverage proof: the replica registry is mutated from every
     replica's heartbeat thread — a lock-free read-modify-write of the

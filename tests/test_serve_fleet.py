@@ -604,7 +604,12 @@ def test_fleet_counters_gate_lower_is_better():
 
 def test_fleet_cli_usage_errors():
     """Inert or contradictory fleet flag combinations are clean exit-2
-    usage errors BEFORE any compile (milliseconds, not trace time)."""
+    usage errors BEFORE any compile (milliseconds, not trace time).
+    PR 13 lifted the PR-11 restrictions: --trace-jsonl /
+    --flight-recorder / --metrics-port are now fleet citizens, so only
+    the still-genuinely-inert combos stay refused — --max-restarts (the
+    one-scheduler supervisor) and --trace-sample with no trace file to
+    sample into."""
     from apex_tpu.serve.cli import main
 
     for argv in (["--hedge-ms", "20"],
@@ -613,9 +618,10 @@ def test_fleet_cli_usage_errors():
                  ["--replicas", "0"],
                  ["--replicas", "2", "--heartbeat-ms", "0"],
                  ["--replicas", "2", "--max-restarts", "1"],
-                 ["--replicas", "2", "--trace-jsonl", "t.json"],
-                 ["--replicas", "2", "--flight-recorder", "f.json"],
-                 ["--replicas", "2", "--metrics-port", "0"]):
+                 ["--trace-sample", "0.5"],
+                 ["--replicas", "2", "--trace-sample", "0.5"],
+                 ["--trace-sample", "1.5", "--trace-jsonl", "t.json"],
+                 ["--trace-sample", "0", "--trace-jsonl", "t.json"]):
         assert main(argv) == 2, argv
 
 
@@ -625,8 +631,8 @@ def test_bench_fleet_usage_errors():
     for kw in ({"hedge_ms": 5.0}, {"heartbeat_ms": 5.0},
                {"replicas": 0},
                {"replicas": 2, "heartbeat_ms": 0.0},
-               {"replicas": 2, "metrics_snapshot": "x.json"},
-               {"replicas": 2, "tenants": 2}):
+               {"trace_sample": 0.5},                  # no --trace-jsonl
+               {"trace_sample": 2.0, "trace_jsonl": "t.json"}):
         with pytest.raises(SystemExit, match="apex-tpu-bench"):
             _serve_bench(2, 2, None, **kw)
 
@@ -643,11 +649,13 @@ def test_fleet_cli_end_to_end(capsys, tmp_path):
     from apex_tpu.serve.cli import main
 
     snap = str(tmp_path / "fleet_snap.json")
+    trace = str(tmp_path / "fleet_trace.json")
     rc = main(["--config", "tiny", "--replicas", "2", "--requests", "4",
                "--prompt-len", "4", "--max-new-tokens", "3",
                "--num-slots", "2", "--max-len", "32",
                "--temperature", "0", "--heartbeat-ms", "250",
-               "--hedge-ms", "5000", "--metrics-snapshot", snap])
+               "--hedge-ms", "5000", "--metrics-snapshot", snap,
+               "--trace-jsonl", trace, "--trace-sample", "1.0"])
     captured = capsys.readouterr()
     assert rc == 0, captured.err      # the usage message names the cause
     lines = [json.loads(l) for l in
@@ -659,7 +667,14 @@ def test_fleet_cli_end_to_end(capsys, tmp_path):
     s = final["summary"]
     assert s["failovers"] == 0 and s["hedge_fired"] == 0
     assert s["migrations"] == 0 and s["replicas"] == 2
-    assert final["decode_compiles"] == [1, 1]
+    assert final["decode_compiles"] == [1, 1], \
+        "fleet tracing must add zero compiles"
+    # PR 13: the journey files landed (fleet plane + one per replica)
+    # and the sampling provenance rode the final line
+    assert final["trace"]["sampled"] == 4
+    assert final["trace"]["promoted"] == 0
+    for p in (trace, trace + ".r0", trace + ".r1"):
+        assert os.path.exists(p), p
     # one mergeable snapshot per replica + the merged fleet view, and
     # the merged counters reconcile with the attempts section
     assert os.path.exists(snap + ".r0") and os.path.exists(snap + ".r1")

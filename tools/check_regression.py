@@ -82,7 +82,11 @@ _LOWER_SUFFIXES = ("_ms", "_s", "_latency")
 # must catch, never a win.
 _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 "shed_rate", "rejected", "deadline_exceeded", "evicted",
-                "failover", "hedge_fired", "replica_dead")
+                "failover", "hedge_fired", "replica_dead",
+                # fleet tracing (PR 13): every promoted journey is a
+                # bad-outcome request the tail capture had to rescue —
+                # a 0 -> N promotion storm gates as a regression
+                "trace_promoted")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated

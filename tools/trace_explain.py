@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Merge fleet + per-replica request-journey traces into per-request
+latency attribution — and verify it reconciles EXACTLY with the fleet
+summary and the goodput ledger's timed causes.
+
+Input: the Chrome-trace files a fleet run writes (``apex-tpu-serve
+--replicas N --trace-jsonl PATH`` → ``PATH`` fleet plane + ``PATH.rK``
+per replica; single-scheduler traces work too), plus optionally:
+
+- ``--events telemetry.jsonl`` — the ``--telemetry-jsonl`` event mirror:
+  the ledger's serve timed causes (``serve_failover``, queue waits, ...)
+  are recomputed from it and held against the failover spans' ``seconds``
+  attrs (the SAME rounded values — exact, not approximate);
+- ``--summary summary.json`` — the CLI's final JSON line (or just its
+  ``summary`` object): journey counts, terminal states,
+  failover/hedge/migration/retry counters, and the TTFT percentiles are
+  reconciled bit-for-bit (journey ttfts ARE the record values the summary
+  computed from).
+
+Output: top-K slowest requests with their dominant latency cause
+(queue / prefill / decode / fleet_queue / backoff / failover), one line
+each, then the reconciliation verdict. ``--perfetto OUT.json`` emits a
+merged Chrome-trace view with **one track per replica** (plus the fleet
+plane) — the side-by-side rendering of a request hopping replicas that
+per-file traces cannot show. ``--json`` prints the attribution rows as
+JSON instead of text.
+
+Head-sampled captures (``--trace-sample`` < 1) are detected from the
+summary's ``trace`` block (or forced with ``--sampled``): checks that
+need EVERY journey present are skipped; the ledger/failover checks still
+run — tail capture promises bad-outcome journeys are always captured.
+
+Exit status: 0 reconciled (or nothing to reconcile against), 1 any
+mismatch — the reconciliation IS the test — 2 usage error.
+
+This tool is **standalone**: it loads ``apex_tpu/monitor/journey.py`` by
+file path (the ``metrics_merge.py`` pattern), so it runs on a machine
+with no jax installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_journey_module():
+    """Load ``apex_tpu/monitor/journey.py`` WITHOUT importing the
+    ``apex_tpu`` package (whose __init__ pulls jax): the module is
+    deliberately stdlib-only at import time for exactly this caller."""
+    path = os.path.join(_REPO, "apex_tpu", "monitor", "journey.py")
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_journey", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_row(j: dict) -> str:
+    parts = []
+    for key, label in (("fleet_queue_s", "fleet_queue"),
+                       ("queue_s", "queue"), ("prefill_s", "prefill"),
+                       ("decode_s", "decode"), ("backoff_s", "backoff"),
+                       ("failover_lost_s", "failover")):
+        v = j.get(key) or 0.0
+        if v > 0:
+            parts.append(f"{label}={v * 1e3:.3f}ms")
+    extras = []
+    if j.get("hedged"):
+        extras.append("hedged")
+    if j.get("failovers"):
+        extras.append(f"failovers={j['failovers']}")
+    if j.get("retries"):
+        extras.append(f"retries={j['retries']}")
+    lat = (j.get("latency_s") or 0.0) * 1e3
+    return (f"{j['request_id']:>12s}  {lat:9.3f}ms  "
+            f"{j['state'] or '?':>9s}  dominant={j['dominant']:<15s} "
+            f"{' '.join(parts)}"
+            + (f"  [{' '.join(extras)}]" if extras else ""))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge fleet + per-replica trace files into "
+                    "per-request latency attribution and verify it "
+                    "reconciles with the summary and the ledger")
+    ap.add_argument("traces", nargs="+",
+                    help="Chrome-trace files (the fleet PATH plus every "
+                         "PATH.rK)")
+    ap.add_argument("--events", default=None,
+                    help="--telemetry-jsonl event mirror: reconcile the "
+                         "failover spans against the ledger's timed "
+                         "causes")
+    ap.add_argument("--summary", default=None,
+                    help="the CLI's final JSON line (or its summary "
+                         "object): reconcile counts + TTFT percentiles")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest requests to print (default 10)")
+    ap.add_argument("--perfetto", default=None,
+                    help="write the merged Chrome-trace view here (one "
+                         "track per replica + the fleet plane)")
+    ap.add_argument("--json", action="store_true",
+                    help="print attribution rows as JSON, not text")
+    ap.add_argument("--sampled", action="store_true",
+                    help="the capture was head-sampled: skip the checks "
+                         "that need every journey present (auto-detected "
+                         "from the summary's trace block)")
+    ap.add_argument("--tolerance", type=float, default=2e-3,
+                    help="stamp-rounding tolerance in seconds for span "
+                         "SUM checks (attr-based checks stay exact; "
+                         "default 2e-3)")
+    args = ap.parse_args(argv)
+
+    journey = load_journey_module()
+
+    for path in args.traces:
+        if not os.path.exists(path):
+            print(f"trace_explain: no such file: {path}",
+                  file=sys.stderr)
+            return 2
+    try:
+        records = journey.load_trace_files(args.traces)
+    except ValueError as e:
+        print(f"trace_explain: {e}", file=sys.stderr)
+        return 2
+
+    summary = None
+    complete = not args.sampled
+    if args.summary:
+        try:
+            with open(args.summary) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"trace_explain: cannot read --summary: {e}",
+                  file=sys.stderr)
+            return 2
+        summary = doc.get("summary", doc)
+        if not isinstance(summary, dict) or "requests" not in summary:
+            print(f"trace_explain: {args.summary} is not a serve "
+                  f"summary (want the CLI's final JSON line or its "
+                  f"'summary' object)", file=sys.stderr)
+            return 2
+        trace_meta = doc.get("trace")
+        if isinstance(trace_meta, dict) \
+                and float(trace_meta.get("sample_rate", 1.0)) < 1.0:
+            complete = False
+
+    causes = counts = None
+    if args.events:
+        try:
+            events = journey.read_events_jsonl(args.events)
+        except (OSError, ValueError) as e:
+            print(f"trace_explain: cannot read --events: {e}",
+                  file=sys.stderr)
+            return 2
+        causes, counts = journey.ledger_causes(events)
+
+    journeys = journey.attribute_journeys(records)
+    if not journeys:
+        print("trace_explain: no request journeys in the given traces "
+              "(were they written with --trace-jsonl?)", file=sys.stderr)
+        return 2
+
+    if args.perfetto:
+        with open(args.perfetto, "w") as f:
+            json.dump(journey.merged_perfetto(records), f)
+        print(f"trace_explain: merged Perfetto view -> {args.perfetto} "
+              f"(one track per replica)", file=sys.stderr)
+
+    top = journey.top_slowest(journeys, args.top)
+    if args.json:
+        print(json.dumps({"journeys": journeys, "top": top},
+                         sort_keys=True, default=float))
+    else:
+        print(f"{len(journeys)} journeys; top {len(top)} slowest:")
+        for j in top:
+            print(_fmt_row(j))
+
+    problems = journey.reconcile(
+        journeys, records, summary=summary, causes=causes,
+        counts=counts, stamp_tol_s=args.tolerance,
+        complete_capture=complete)
+    if problems:
+        for p in problems:
+            print(f"MISMATCH: {p}", file=sys.stderr)
+        print(f"trace_explain: {len(problems)} reconciliation "
+              f"mismatch(es) — span attribution does not agree with "
+              f"the summary/ledger accounting", file=sys.stderr)
+        return 1
+    if summary is None and causes is None:
+        print("trace_explain: attribution only (pass --summary/--events "
+              "to reconcile)", file=sys.stderr)
+    else:
+        checked = []
+        if summary is not None:
+            checked.append("summary" + ("" if complete
+                                        else " (sampled subset)"))
+        if causes is not None:
+            checked.append("ledger causes")
+        print(f"trace_explain: reconciled against {' + '.join(checked)}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
