@@ -30,6 +30,7 @@ from apex_tpu import resilience  # noqa: F401
 from apex_tpu import monitor  # noqa: F401
 from apex_tpu import tune  # noqa: F401
 from apex_tpu import serve  # noqa: F401
+from apex_tpu import train  # noqa: F401
 
 __version__ = "0.1.0"
 

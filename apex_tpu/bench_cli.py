@@ -241,6 +241,86 @@ def _telemetry_bench(jsonl_path: "str | None", steps: int = 8,
         "goodput": summary["goodput"]["goodput_frac"]}))
 
 
+def _train_chaos_bench(steps: int = 12, world: int = 1,
+                       grad_shards: "int | None" = None,
+                       emit_baseline: "str | None" = None) -> None:
+    """Trainer chaos smoke (``--train-chaos``): run the production
+    trainer under its supervisor through a seeded crash + mid-save-crash
+    + preemption/relaunch schedule, and emit a suite-shaped
+    ``train_chaos`` entry.
+
+    The headline value is steps/s (higher-is-better); the resilience
+    counters (``restarts``/``preempt_drains``/``steps_retried`` — all
+    lower-is-better to the gate) ride the entry so a chaos capture that
+    suddenly restarts more gates as a regression. Trainer workload
+    provenance (world size, gradient-shard parallelism, amp dtype) nests
+    under ``workload`` so elastic captures never gate against
+    incomparable configs (the serve-bench precedent)."""
+    import json
+    import tempfile
+    import time
+
+    from apex_tpu.resilience import FaultInjector
+    from apex_tpu.train import TrainConfig, TrainSupervisor
+
+    g = grad_shards if grad_shards is not None else max(1, world)
+    steps = max(6, int(steps))
+    config = TrainConfig(steps=steps, batch=8, seq=16, world=world,
+                         grad_shards=g, seed=0)
+    with tempfile.TemporaryDirectory() as ckpt_dir:
+        import dataclasses
+
+        config = dataclasses.replace(config, checkpoint_dir=ckpt_dir,
+                                     save_every=max(1, steps // 4))
+        # the seeded schedule: a fatal step error (warm restart), a death
+        # mid-checkpoint-commit (previous step must restore), and one
+        # coordinated preemption drain + same-topology relaunch
+        inj = (FaultInjector(seed=0)
+               .crash_on_train_step(steps // 3)
+               .crash_during_checkpoint_save(
+                   (steps // 2) - (steps // 2) % config.save_every)
+               .preempt_at_step(2 * steps // 3))
+        supervisor = TrainSupervisor(config, injector=inj,
+                                     max_restarts=3,
+                                     world_schedule=[world, world])
+        t0 = time.perf_counter()
+        report = supervisor.run()
+        wall = time.perf_counter() - t0
+    counts = supervisor.trace_counts()
+    suite = {
+        "train_chaos": {
+            "metric": "train_chaos_steps_per_s",
+            "value": round(report["goodput"]["steps"] / wall, 3),
+            "unit": "steps_per_s",
+            # lower-is-better resilience counters (the gate knows all
+            # three; a 0 -> N storm off this baseline is a regression)
+            "restarts": report["restarts"],
+            "preempt_drains": report["preempt_drains"],
+            "steps_retried": report["steps_retried"],
+            "goodput_frac": round(report["goodput"]["goodput_frac"], 6),
+            # recompiles across the whole chaos run (lower-is-better to
+            # the gate via the "recompile" hint; the contract is exactly
+            # one trace — >1 means a restart recompiled)
+            "step_recompiles": counts["shard_grads"],
+            "bench_wall_s": round(wall, 3),
+            "workload": {"steps": steps, "batch": config.batch,
+                         "seq": config.seq,
+                         "world": world, "grad_shards": g,
+                         "amp_dtype": config.amp,
+                         "save_every": config.save_every,
+                         "max_restarts": 3},
+            "complete": False,
+        },
+    }
+    if emit_baseline:
+        bench = _load_bench_module()
+        bench.atomic_write_json(emit_baseline, suite)
+        print(json.dumps({"baseline": emit_baseline,
+                          "kernels": ["train_chaos"]}))
+    else:
+        print(json.dumps(suite, indent=1))
+
+
 def _load_bench_module():
     """Import the repo checkout's bench.py (the suite/baseline machinery
     lives there, not in the wheel). Exits 2 with a clear message on a
@@ -769,26 +849,65 @@ def main() -> None:
         # (the serve bench has no event mirror; swallowing the flag
         # would be the silent-no-op class this matrix refuses)
         has_serve = any(a == "--serve" for a in sys.argv[1:])
+        has_train_chaos = any(a == "--train-chaos" for a in sys.argv[1:])
         has_telemetry = any(
             a.split("=", 1)[0] == "--telemetry-jsonl"
             for a in sys.argv[1:]) or (
             any(a.split("=", 1)[0] in ("--trace-jsonl",
                                        "--flight-recorder")
                 for a in sys.argv[1:]) and not has_serve)
-        # --emit-baseline is shared by the serve and kernel-subset modes;
-        # --kernels is NOT valid with --serve and must keep refusing
+        # --emit-baseline is shared by the serve, train-chaos, and
+        # kernel-subset modes; --kernels is NOT valid with --serve or
+        # --train-chaos and must keep refusing
         has_subset = any(a.split("=", 1)[0] == "--kernels"
                          for a in sys.argv[1:]) or (
             any(a.split("=", 1)[0] == "--emit-baseline"
-                for a in sys.argv[1:]) and not has_serve)
-        if sum((has_telemetry, has_subset, has_serve)) > 1:
+                for a in sys.argv[1:]) and not has_serve
+            and not has_train_chaos)
+        if sum((has_telemetry, has_subset, has_serve,
+                has_train_chaos)) > 1:
             # parse_known_args would silently swallow the other mode's
             # flags — refuse instead of pretending both ran
-            print("apex-tpu-bench: --telemetry-jsonl, --serve, and "
-                  "--kernels/--emit-baseline are separate modes; run "
-                  "them as separate invocations", file=sys.stderr)
+            print("apex-tpu-bench: --telemetry-jsonl, --serve, "
+                  "--train-chaos, and --kernels/--emit-baseline are "
+                  "separate modes; run them as separate invocations",
+                  file=sys.stderr)
             sys.exit(2)
-        if has_serve:
+        if has_train_chaos:
+            import argparse
+
+            ap = argparse.ArgumentParser(prog="apex-tpu-bench")
+            ap.add_argument("--train-chaos", action="store_true")
+            ap.add_argument("--steps", type=int, default=12,
+                            help="train steps the chaos schedule runs "
+                                 "over (min 6 so every fault fires)")
+            ap.add_argument("--world", type=int, default=1,
+                            help="data-parallel degree (thread-faked "
+                                 "ranks; must divide --grad-shards)")
+            ap.add_argument("--grad-shards", type=int, default=None,
+                            help="fixed micro-shard count (default: "
+                                 "world)")
+            ap.add_argument("--emit-baseline", nargs="?",
+                            const="BENCH_BASELINE_TRAIN.json",
+                            default=None,
+                            help="write the capture as a suite JSON "
+                                 "(default BENCH_BASELINE_TRAIN.json)")
+            args, _ = ap.parse_known_args(sys.argv[1:])
+            shards = (args.grad_shards if args.grad_shards is not None
+                      else max(1, args.world))
+            # the full geometry contract, as a loud exit-2 BEFORE any
+            # params/compile work (the TrainConfig would refuse anyway,
+            # but as a traceback, not a usage error): world | shards
+            # AND shards | the fixed bench batch of 8
+            if args.world < 1 or shards % args.world or 8 % shards:
+                print(f"apex-tpu-bench: --train-chaos needs --world "
+                      f">= 1 dividing --grad-shards (got {args.world}/"
+                      f"{shards}), and --grad-shards dividing the "
+                      f"bench batch of 8", file=sys.stderr)
+                sys.exit(2)
+            _train_chaos_bench(args.steps, args.world, args.grad_shards,
+                               args.emit_baseline)
+        elif has_serve:
             import argparse
 
             ap = argparse.ArgumentParser(prog="apex-tpu-bench")

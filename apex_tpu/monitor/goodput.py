@@ -64,6 +64,16 @@ STALL_EVENTS = {
     # (bit-identically under greedy decoding), plus the queue time the
     # migration wasted. Overlaps other serving causes by design.
     "serve_failover": "serve_failover",
+    # production trainer (apex_tpu.train, PR 14): the span between the
+    # coordinated preemption agreement and the clean exit — finishing the
+    # in-flight step, draining collectives, and committing the one final
+    # synchronous checkpoint (rank 0 publishes once per drain)
+    "train_preempt_drain": "train_preempt_drain",
+    # a step re-executed after a crash rollback: real wall time spent
+    # redoing work the crash discarded, never double-counted as
+    # productive — the supervisor's job-scope high-water mark guarantees
+    # each step index lands in the ledger as productive exactly once
+    "train_step_replayed": "train_replay",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
@@ -101,6 +111,12 @@ COUNTED_EVENTS = (
     # counted, because every promotion is a bad-outcome request (the
     # regression gate treats trace_promoted as lower-is-better)
     "serve_trace_promoted",
+    # production trainer (apex_tpu.train): one supervisor warm restart
+    # after a fatal step error (bounded by max_restarts), a sharded
+    # checkpoint restored at a different data-parallel world size than it
+    # was saved under (the elastic-resize signal), and each committed
+    # checkpoint (rank 0 publishes once per commit/resize/restart)
+    "train_restart", "train_elastic_resized", "train_checkpoint_commit",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

@@ -104,6 +104,17 @@ class Coordinator:
     def all_any(self, flag: bool) -> bool:
         raise NotImplementedError
 
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        """Gather one per-process payload into a rank-indexed list (every
+        process returns the same list) — the data-parallel trainer's
+        gradient-exchange seam (:mod:`apex_tpu.train`). A world of one
+        short-circuits; implementations define the payload contract
+        (the jax coordinator requires a pytree of equal-shape arrays,
+        the thread harness passes any object by reference)."""
+        if self.process_count == 1:
+            return [obj]
+        raise NotImplementedError
+
     def device_rank(self, device) -> int:
         return int(getattr(device, "process_index", 0))
 
@@ -146,6 +157,20 @@ class JaxCoordinator(Coordinator):
             np.asarray([bool(flag)], dtype=np.bool_))
         return bool(np.any(flags))
 
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        """Real multi-host gather via ``process_allgather``: ``obj`` must
+        be a pytree of arrays with identical structure and shapes on every
+        process (the trainer's equal-shards-per-rank contract guarantees
+        this). Leaves come back stacked along a leading process axis and
+        are unstacked into the rank-indexed list."""
+        if self.process_count == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(obj)
+        return [jax.tree_util.tree_map(lambda x: x[r], stacked)
+                for r in range(self.process_count)]
+
 
 class ThreadProcessGroup:
     """N threads standing in for N processes (the CPU test double).
@@ -175,6 +200,7 @@ class ThreadProcessGroup:
         self.barrier_timeout_s = barrier_timeout_s
         self._barrier = threading.Barrier(world)
         self._flags = [False] * world
+        self._mailbox: List[Any] = [None] * world
         from apex_tpu.parallel.mesh import device_process_map
 
         devs = devices if devices is not None else jax.devices()
@@ -242,6 +268,17 @@ class _ThreadCoordinator(Coordinator):
         self.barrier("all_any:write")
         result = any(self.group._flags)
         self.barrier("all_any:read")
+        return result
+
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        # same two-barrier discipline as all_any: the read barrier keeps a
+        # fast rank's NEXT round's write from clobbering a slot a slow
+        # rank has not read yet (threads share one process, so payloads —
+        # device arrays included — cross by reference, no serialization)
+        self.group._mailbox[self.process_index] = obj
+        self.barrier("all_gather:write")
+        result = list(self.group._mailbox)
+        self.barrier("all_gather:read")
         return result
 
     def device_rank(self, device) -> int:

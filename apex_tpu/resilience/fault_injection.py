@@ -47,6 +47,22 @@ Production failure modes, reproduced on a laptop with a seed:
   fleet smoke runs kill + partition + straggler in one seeded schedule
   and asserts every submitted request reaches exactly one terminal
   status fleet-wide.
+- **Trainer chaos** — step-level failure for the production trainer
+  (:mod:`apex_tpu.train`): ``crash_on_train_step(at_step)`` raises
+  :class:`SimulatedCrash` the instant a rank would run that train step
+  (a fatal XLA/runtime error mid-step — the supervisor's warm-restart
+  trigger; ``times > 1`` re-fires after each rollback, driving the
+  restart budget), ``crash_during_checkpoint_save(step)`` kills the
+  process on its first write into that step's ``.tmp`` staging (a
+  preemption landing mid-save — the previous committed step must stay
+  restorable), ``preempt_at_step(at_step, rank)`` feeds one rank's
+  :class:`~apex_tpu.resilience.preemption.PreemptionGuard` through the
+  programmatic ``request_stop`` path (the coordinated-drain workload),
+  and ``straggler_rank(rank, delay_s, at_step)`` stalls one rank's step
+  window (what the collective watchdog must surface on the gradient
+  exchange). The tier-1 chaos smoke mixes all of them in one seeded
+  schedule and asserts bit-identical final params vs an uninterrupted
+  run.
 - **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
   window of steps whose gradients ``poison_grads`` fills with NaN/Inf
   (choice seeded), reproducing the overflow storms that collapse a dynamic
@@ -108,6 +124,12 @@ class _InjectedFilesystem(Filesystem):
             super().write_bytes(path, data[:keep])
             raise SimulatedCrash(
                 f"torn write: {keep}/{len(data)} bytes of {path}")
+        if inj._ckpt_crash_due(path):
+            # trainer chaos: the process dies on its first write into the
+            # scheduled step's .tmp staging — a preemption mid-save; the
+            # previous committed step must remain the restore target
+            raise SimulatedCrash(
+                f"process died mid-checkpoint-save writing {path}")
         if inj._matches(inj._crash_write_patterns, path):
             # the process dies the instant it reaches this file — nothing
             # of it lands on disk (e.g. between the per-process shard
@@ -147,6 +169,11 @@ class FaultInjector:
         self._replica_kills: Dict[str, int] = {}
         self._partitions: Dict[str, List[int]] = {}    # [start, end)
         self._replica_straggles: Dict[str, List[float]] = {}
+        # trainer chaos (train-step units / checkpoint step numbers)
+        self._train_crashes: Dict[int, int] = {}       # step -> remaining
+        self._ckpt_crash_steps: set = set()            # checkpoint steps
+        self._train_preempts: List[List[int]] = []     # [rank, at_step]
+        self._rank_straggles: Dict[int, List[float]] = {}  # rank -> window
 
     # ---- filesystem faults ---------------------------------------------
     def filesystem(self) -> Filesystem:
@@ -403,6 +430,97 @@ class FaultInjector:
         """Seconds this replica's worker should stall this tick."""
         ent = self._replica_straggles.get(str(replica_id))
         if ent and ent[0] <= tick < ent[1]:
+            return ent[2]
+        return 0.0
+
+    # ---- trainer chaos --------------------------------------------------
+    def crash_on_train_step(self, at_step: int,
+                            times: int = 1) -> "FaultInjector":
+        """Raise :class:`SimulatedCrash` when a trainer rank would run
+        train step ``at_step`` — a fatal XLA/runtime error mid-step, at an
+        exact replayable point. ``times > 1`` re-fires after each warm
+        restart (the checkpoint rollback makes the trainer reach the same
+        step again) — how the restart-budget-exhaustion path is driven."""
+        self._train_crashes[int(at_step)] = \
+            self._train_crashes.get(int(at_step), 0) + max(1, int(times))
+        return self
+
+    def maybe_crash_train(self, step: int, rank: int = 0) -> None:
+        """Consulted by every trainer rank just before the step runs;
+        raises on all ranks while a firing is scheduled for ``step``.
+        Only rank 0's call consumes the firing — one scheduled crash is
+        one job-attempt failure, however many rank threads reach the
+        step before the group aborts (per-rank consumption would burn
+        ``times > 1`` budgets world-times faster, and a single rank
+        decrementing also keeps the bookkeeping race-free)."""
+        left = self._train_crashes.get(int(step), 0)
+        if left <= 0:
+            return
+        if int(rank) == 0:
+            if left == 1:
+                self._train_crashes.pop(int(step), None)
+            else:
+                self._train_crashes[int(step)] = left - 1
+        raise SimulatedCrash(
+            f"injected fatal train-step error at step {step} "
+            f"(rank {rank})")
+
+    def crash_during_checkpoint_save(self, step: int) -> "FaultInjector":
+        """Kill the process on its first write into checkpoint ``step``'s
+        ``.tmp`` staging directory (the trainer must run with
+        ``fs=injector.filesystem()``) — a preemption landing mid-save.
+        The atomic-commit discipline means the previous committed step
+        stays fully restorable; the retried save (the schedule is
+        consumed) then commits cleanly. Keyed by the step being saved, so
+        the schedule is deterministic regardless of save cadence."""
+        self._ckpt_crash_steps.add(int(step))
+        return self
+
+    def _ckpt_crash_due(self, path: str) -> bool:
+        """Consumed by the injected filesystem on every write (one firing
+        per scheduled step)."""
+        if not self._ckpt_crash_steps:
+            return False
+        m = re.search(r"step_(\d{8})\.tmp/", path.replace(os.sep, "/"))
+        if m and int(m.group(1)) in self._ckpt_crash_steps:
+            self._ckpt_crash_steps.discard(int(m.group(1)))
+            return True
+        return False
+
+    def preempt_at_step(self, at_step: int,
+                        rank: int = 0) -> "FaultInjector":
+        """Deliver a programmatic preemption to one trainer rank before
+        train step ``at_step``: the rank calls ``guard.request_stop()``,
+        and in coordinated mode every rank agrees to drain at the same
+        step boundary — exactly the path a scheduler SIGTERM takes,
+        without a real signal (thread-faked ranks cannot install
+        handlers). One-shot per schedule: the window fires on the first
+        step >= ``at_step`` the rank actually reaches."""
+        self._train_preempts.append([int(rank), int(at_step)])
+        return self
+
+    def train_preempt_due(self, rank: int, step: int) -> bool:
+        """Consumed by the trainer loop each step (fires once)."""
+        for ent in self._train_preempts:
+            if ent[0] == int(rank) and int(step) >= ent[1]:
+                self._train_preempts.remove(ent)
+                return True
+        return False
+
+    def straggler_rank(self, rank: int, delay_s: float, at_step: int = 1,
+                       steps: int = 1) -> "FaultInjector":
+        """Stall each of one trainer rank's steps in ``[at_step,
+        at_step + steps)`` by ``delay_s`` — a slow host that is alive but
+        late: peers block in the gradient exchange, which is what the
+        collective watchdog must surface as a ``collective_stall``."""
+        self._rank_straggles[int(rank)] = [
+            float(at_step), float(at_step) + float(steps), float(delay_s)]
+        return self
+
+    def train_straggle_due(self, rank: int, step: int) -> float:
+        """Seconds this trainer rank should stall this step."""
+        ent = self._rank_straggles.get(int(rank))
+        if ent and ent[0] <= step < ent[1]:
             return ent[2]
         return 0.0
 

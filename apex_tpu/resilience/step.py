@@ -89,9 +89,15 @@ class ResilientStep:
         self.consecutive_overflows = 0
         self.skipped_steps = 0
         self.degraded = False
+        # trace counter (the serve engine's decode_traces idiom): bumps as
+        # a Python side effect each time jax TRACES _post, so a warm
+        # restart that recompiles nothing keeps it flat — tier-1's
+        # zero-recompile-restart proof for the trainer reads it
+        self.post_traces = 0
 
         def _post(new_params, params, sstate, found_inf, *, freeze_growth,
                   with_metrics):
+            self.post_traces += 1
             kept = skip_on_overflow(new_params, params, found_inf)
             sstate = self.scaler.update(sstate, found_inf,
                                         freeze_growth=freeze_growth)

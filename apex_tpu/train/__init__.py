@@ -1,0 +1,35 @@
+"""apex_tpu.train — the elastic, preemption-tolerant production trainer.
+
+The TorchTitan-class composition layer (ROADMAP item 4): one
+config-driven system over what the repo built but never unified —
+
+- :mod:`~apex_tpu.train.config` — :class:`TrainConfig`: model shape,
+  data-parallel degree + gradient-shard geometry, AMP policy,
+  checkpoint/elastic settings, observability wiring, in one dataclass.
+- :mod:`~apex_tpu.train.trainer` — :class:`Trainer`: one rank's loop,
+  composing ``ResilientStep`` + ``DynamicGradScaler``,
+  ``ShardedCheckpointManager``, ``PreemptionGuard``,
+  ``CollectiveWatchdog``, and ``Telemetry(registry=...)``. The canonical
+  shard-indexed gradient reduction makes every update bit-identical at
+  any world size — the property elastic restarts ride.
+- :mod:`~apex_tpu.train.supervisor` — :class:`TrainSupervisor`: the job
+  loop owning the robustness contract — bounded warm restarts with
+  exponential backoff (zero recompiles on same-topology restart),
+  coordinated preemption drain with one final atomic commit, elastic
+  world-schedule relaunches, and job-scope exactly-once step accounting
+  in the goodput ledger.
+- :mod:`~apex_tpu.train.cli` — the ``apex-tpu-train`` entry point with
+  its seeded ``--chaos`` schedule surface.
+
+See docs/training.md for the contracts and the chaos-harness catalog.
+"""
+
+from apex_tpu.train.config import AMP_MODES, TrainConfig  # noqa: F401
+from apex_tpu.train.supervisor import TrainSupervisor  # noqa: F401
+from apex_tpu.train.trainer import (  # noqa: F401
+    Trainer, make_scaler, tiny_lm_batch, tiny_lm_params)
+
+__all__ = [
+    "AMP_MODES", "TrainConfig", "Trainer", "TrainSupervisor",
+    "make_scaler", "tiny_lm_batch", "tiny_lm_params",
+]

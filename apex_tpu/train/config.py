@@ -1,0 +1,131 @@
+"""``TrainConfig`` — the one dataclass a production training run reads.
+
+Model shape, data-parallel degree, gradient-shard geometry, AMP policy,
+checkpoint/elastic settings, and observability wiring all live here so a
+run is reproducible from its config alone (the TorchTitan property:
+*one* config drives the trainer, the supervisor, the CLI, and the bench).
+
+The field every correctness claim hangs off is ``grad_shards``: the
+global batch is cut into that many **fixed micro-shards**, and the step's
+gradient is the shard gradients summed in shard-index order — whatever
+world size computed them. Because the shard partitioning (and therefore
+every compiled shape and every float-add order) is a property of the
+config, not of the world, a run restored at a different data-parallel
+degree continues **bit-exactly**, and a same-topology restart reuses
+every compiled executable. ``world`` must divide ``grad_shards`` so each
+rank owns the same number of shards (the gather seam requires equal
+payloads per rank).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from apex_tpu.resilience.step import DEFAULT_SCALE_FLOOR
+
+AMP_MODES = ("off", "dynamic")
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Everything :class:`~apex_tpu.train.Trainer` and
+    :class:`~apex_tpu.train.TrainSupervisor` need, in one place.
+
+    The built-in workload is a tiny seeded LM (embedding → tanh MLP →
+    LM head) whose batches are a pure function of ``(seed, step)`` — the
+    determinism every chaos/elastic bit-exactness proof rides on. A
+    custom model plugs in through ``Trainer(loss_fn=, init_params=,
+    batch_fn=)`` and inherits the same loop, checkpointing, preemption,
+    and accounting (see ``examples/lm_pretrain``).
+    """
+
+    # workload
+    steps: int = 8
+    batch: int = 8
+    seq: int = 16
+    vocab: int = 128
+    hidden: int = 32
+    lr: float = 1e-2
+    seed: int = 0
+
+    # parallelism: data-parallel degree (the fake-multihost thread
+    # harness on CPU tier-1; real pods rendezvous via JaxCoordinator) and
+    # the world-independent micro-shard count (see module docstring)
+    world: int = 1
+    grad_shards: int = 1
+
+    # AMP: "dynamic" = fp16-style dynamic loss scaling through
+    # DynamicGradScaler + ResilientStep; "off" = unscaled (bf16-first)
+    amp: str = "dynamic"
+    init_scale: float = 2.0 ** 12
+    scale_floor: float = DEFAULT_SCALE_FLOOR
+    max_consecutive_overflows: int = 8
+
+    # checkpointing / elasticity
+    checkpoint_dir: Optional[str] = None
+    save_every: int = 0          # 0 = only the final / preemption commit
+    sharded_checkpoint: bool = True
+    max_to_keep: int = 3
+
+    # observability
+    telemetry_jsonl: Optional[str] = None
+    trace_jsonl: Optional[str] = None
+    watchdog_timeout_s: Optional[float] = None
+
+    def validate(self) -> "TrainConfig":
+        """Refuse contradictory geometry loudly, before anything compiles
+        (the CLI turns these into its exit-2 usage errors)."""
+        if self.steps < 1:
+            raise ValueError(f"steps must be >= 1, got {self.steps}")
+        if self.seq < 2:
+            raise ValueError(
+                f"seq must be >= 2 (next-token pairs), got {self.seq}")
+        if self.vocab < 2 or self.hidden < 1:
+            raise ValueError(
+                f"vocab/hidden must be positive, got "
+                f"{self.vocab}/{self.hidden}")
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {self.world}")
+        if self.grad_shards < 1:
+            raise ValueError(
+                f"grad_shards must be >= 1, got {self.grad_shards}")
+        if self.grad_shards % self.world:
+            raise ValueError(
+                f"world {self.world} must divide grad_shards "
+                f"{self.grad_shards} (equal shards per rank is what makes "
+                f"elastic restarts bit-exact)")
+        if self.batch % self.grad_shards:
+            raise ValueError(
+                f"grad_shards {self.grad_shards} must divide batch "
+                f"{self.batch}")
+        if self.amp not in AMP_MODES:
+            raise ValueError(f"amp must be one of {AMP_MODES}, "
+                             f"got {self.amp!r}")
+        if self.save_every < 0:
+            raise ValueError(
+                f"save_every must be >= 0, got {self.save_every}")
+        if self.save_every and not self.checkpoint_dir:
+            raise ValueError("save_every needs checkpoint_dir")
+        if self.world > 1 and self.checkpoint_dir \
+                and not self.sharded_checkpoint:
+            raise ValueError(
+                "world > 1 needs sharded_checkpoint=True (the dense "
+                "manager has no commit protocol across ranks)")
+        if self.watchdog_timeout_s is not None \
+                and self.watchdog_timeout_s <= 0:
+            raise ValueError(
+                f"watchdog_timeout_s must be > 0, got "
+                f"{self.watchdog_timeout_s}")
+        return self
+
+    def static_key(self) -> Tuple:
+        """The jit-cache key for the built-in workload's compiled step
+        functions: everything that shapes a trace — and nothing that
+        doesn't (checkpoint dirs, telemetry paths), so a restarted or
+        elastically resized job with the same workload reuses every
+        compiled executable. ``world`` is deliberately absent: shard
+        shapes are world-independent by construction."""
+        return (self.batch // self.grad_shards, self.seq, self.vocab,
+                self.hidden, self.grad_shards, self.lr, self.amp,
+                self.init_scale, self.scale_floor, self.seed)

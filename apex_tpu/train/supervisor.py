@@ -1,0 +1,295 @@
+"""``TrainSupervisor`` — the job-level robustness contract.
+
+The design mirror of :class:`apex_tpu.serve.resilience.ServeSupervisor`:
+bounded retry + exponential backoff around the run loop, owning the three
+failure paths end to end:
+
+- **crash recovery** — a fatal error on any rank (an injected
+  ``SimulatedCrash``, a real XLA/runtime fault) ends the attempt (peers
+  unblock with ``CollectiveStallError`` instead of hanging — the
+  ``ThreadProcessGroup`` contract); the supervisor publishes
+  ``train_restart``, backs off, and relaunches the SAME topology. Cached
+  :class:`~apex_tpu.train.trainer.Trainer` objects are re-bound to the
+  fresh rendezvous, so every compiled executable survives — a
+  same-topology restart adds **zero recompiles** (tier-1 reads the trace
+  counters). Each attempt restores the last committed checkpoint at
+  entry; after ``max_restarts`` failed attempts the root-cause exception
+  propagates (the last committed step stays on disk).
+- **coordinated preemption** — a stop on any rank (scheduler SIGTERM via
+  :meth:`install_signals`, an injected ``preempt_at_step``, or
+  :meth:`request_stop`) is agreed collectively at a step boundary; every
+  rank drains, ONE final checkpoint commits atomically
+  (``train_preempt_drain`` carries the drain seconds), and the attempt
+  exits clean. With more entries left in ``world_schedule`` the
+  supervisor relaunches at the next world — **elastic resize** — else it
+  returns a preempted report.
+- **exactly-once accounting** — the supervisor owns the job's ONE
+  telemetry sink + goodput ledger and threads its step high-water mark
+  through every attempt: each step index lands as productive exactly
+  once; replayed executions ride the ``train_replay`` cause. Caveat of
+  the fake-multihost harness: its ranks share ONE process event bus, so
+  per-rank bus records (``checkpoint_save_stall`` — barrier-overlapped
+  spans summed across ranks, ``overflow_step_skipped``,
+  ``preemption_requested``) appear world-times in the ledger's event
+  counts and the ``checkpoint_save`` cause, each carrying its ``rank``.
+  The exactly-once contract is about STEP accounting (``steps`` /
+  ``skipped_steps`` / ``train_replay``), which rank 0 alone records —
+  on a real pod every process has its own bus and the per-rank records
+  separate naturally.
+
+Threading contract: :meth:`run` executes on one control thread; rank
+threads touch only their own trainer, the coordinator, and this object's
+progress table — every ``_rank_status``/``_trainers`` mutation happens
+under ``_lock`` (rank threads report concurrently). ``_stop`` is a plain
+one-way rebind (signal handler / control thread writes, rank threads
+read) — the snapshot idiom, no lock needed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from apex_tpu.monitor.telemetry import Telemetry
+from apex_tpu.resilience.distributed import (CollectiveStallError,
+                                             ThreadProcessGroup)
+from apex_tpu.resilience.preemption import PreemptionGuard
+from apex_tpu.train.config import TrainConfig
+from apex_tpu.train.trainer import Trainer
+from apex_tpu.utils.logging import publish_event
+
+
+class TrainSupervisor:
+    """Run a data-parallel training job to completion across crashes,
+    preemptions, and world-size changes (see module docstring).
+
+    ``world_schedule`` is the elastic plan: the job starts at entry 0 and
+    advances one entry per coordinated-preemption drain (the relaunch
+    restores the same sharded checkpoint at the new world — bit-exactly,
+    by the trainer's canonical shard reduction). Crash restarts stay on
+    the current entry: same topology, zero recompiles. Defaults to
+    ``[config.world]``.
+    """
+
+    def __init__(self, config: TrainConfig, *, injector=None,
+                 max_restarts: int = 2, backoff_s: float = 0.05,
+                 backoff_factor: float = 2.0, max_backoff_s: float = 2.0,
+                 sleep=time.sleep, world_schedule: Optional[List[int]] = None,
+                 registry=None, barrier_timeout_s: float = 60.0,
+                 loss_fn: Optional[Callable] = None, init_params: Any = None,
+                 batch_fn: Optional[Callable[[int], Any]] = None):
+        self.config = config.validate()
+        self.injector = injector
+        self.max_restarts = max(0, int(max_restarts))
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.max_backoff_s = float(max_backoff_s)
+        self.sleep = sleep
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self._custom = {"loss_fn": loss_fn, "init_params": init_params,
+                        "batch_fn": batch_fn}
+        worlds = list(world_schedule) if world_schedule else [config.world]
+        for w in worlds:
+            if w < 1 or config.grad_shards % w:
+                raise ValueError(
+                    f"world_schedule entry {w} must be >= 1 and divide "
+                    f"grad_shards {config.grad_shards}")
+        if len(worlds) > 1 and not config.checkpoint_dir:
+            raise ValueError(
+                "an elastic world_schedule needs checkpoint_dir: the "
+                "resize crosses a restart, and only a committed sharded "
+                "checkpoint carries the state over")
+        self._worlds = worlds
+        self._world_idx = 0
+        self.world_history: List[int] = []
+
+        self.restarts = 0
+        self.preempt_drains = 0
+        self.hwm = 0
+        # ONE job-scope sink: rank-0 trainers of every attempt/world share
+        # it, so the ledger's step accounting is exactly-once job-wide
+        self.telemetry = Telemetry(
+            config.telemetry_jsonl, rank_zero_only=False,
+            tokens_per_step=float(config.batch * (config.seq - 1)),
+            trace_jsonl=config.trace_jsonl, registry=registry)
+
+        self._lock = threading.Lock()
+        self._trainers: Dict[Any, Trainer] = {}
+        self._rank_status: Dict[int, Dict[str, Any]] = {}
+        # one-way stop flag: written by request_stop()/the signal guard,
+        # read by every rank thread (plain rebind — the snapshot idiom)
+        self._stop = False
+        self._main_guard: Optional[PreemptionGuard] = None
+        self._closed = False
+
+    # ---- external control ----------------------------------------------
+    def request_stop(self) -> None:
+        """Programmatic drain: the next step boundary on every rank joins
+        the coordinated preemption agreement."""
+        self._stop = True
+
+    def install_signals(self) -> "TrainSupervisor":
+        """Arm a main-thread SIGTERM/SIGINT guard (the CLI path): a
+        scheduler signal feeds the same coordinated drain an injected
+        preemption does. Rank threads cannot install handlers — this is
+        the one process-level bridge."""
+        self._main_guard = PreemptionGuard().install()
+        return self
+
+    def _external_stop(self) -> bool:
+        if self._stop:
+            return True
+        return (self._main_guard is not None
+                and self._main_guard.should_stop())
+
+    # ---- live status (rank threads report, control thread reads) -------
+    def _progress(self, rank: int, step: int) -> None:
+        with self._lock:
+            self._rank_status[rank] = {"step": step}
+
+    def status(self) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            return {r: dict(v) for r, v in self._rank_status.items()}
+
+    # ---- trainer cache --------------------------------------------------
+    def _trainer_for(self, world: int, rank: int, coord) -> Trainer:
+        with self._lock:
+            trainer = self._trainers.get((world, rank))
+            if trainer is None:
+                trainer = Trainer(
+                    self.config, coordinator=coord,
+                    injector=self.injector,
+                    telemetry=self.telemetry if rank == 0 else None,
+                    hwm=self.hwm, **self._custom)
+                self._trainers[(world, rank)] = trainer
+            else:
+                # same-topology relaunch: every compiled artifact (the
+                # cached step fns AND the ResilientStep post-step) is
+                # reused — the zero-recompile restart contract
+                trainer.rebind(coord)
+                trainer.hwm = self.hwm
+            return trainer
+
+    def trace_counts(self) -> Dict[str, int]:
+        """Aggregate lifetime trace counts over every cached trainer.
+        Counter dicts are deduped by identity and then summed: built-in
+        workload trainers share ONE lru-cached dict (so the job total is
+        that dict's count), while custom-``loss_fn`` trainers each carry
+        their own — a per-trainer recompile on an elastic resize shows
+        up in the sum instead of hiding behind a max. ``post`` is always
+        per-trainer."""
+        out = {"shard_grads": 0, "apply": 0, "post": 0}
+        with self._lock:
+            trainers = list(self._trainers.values())
+        distinct = {id(tr._counts): tr._counts for tr in trainers}
+        for c in distinct.values():
+            out["shard_grads"] += c["shard_grads"]
+            out["apply"] += c["apply"]
+        out["post"] = sum(tr.trace_counts()["post"] for tr in trainers)
+        return out
+
+    # ---- the job loop ---------------------------------------------------
+    def _launch(self, world: int):
+        group = ThreadProcessGroup(world, injector=self.injector,
+                                   barrier_timeout_s=self.barrier_timeout_s)
+
+        def _rank_fn(coord, rank):
+            trainer = self._trainer_for(world, rank, coord)
+            return trainer.run(external_stop=self._external_stop,
+                               progress=self._progress)
+
+        return group.run(_rank_fn)
+
+    def run(self) -> Dict[str, Any]:
+        """Drive the job to completion (or a final preempted drain);
+        returns the job report. Raises the root-cause exception once the
+        restart budget is exhausted — the last committed checkpoint is
+        still on disk."""
+        try:
+            return self._run()
+        finally:
+            self.close()
+
+    def _run(self) -> Dict[str, Any]:
+        last_report: Optional[Dict[str, Any]] = None
+        while True:
+            world = self._worlds[self._world_idx]
+            self.world_history.append(world)
+            results = self._launch(world)
+            with self._lock:
+                rank0 = self._trainers.get((world, 0))
+            if rank0 is not None:
+                self.hwm = max(self.hwm, rank0.hwm)
+            excs = [e for _, e in results if e is not None]
+            if not excs:
+                last_report = results[0][0]
+                if last_report["preempted"]:
+                    self.preempt_drains += 1
+                    if self._world_idx + 1 < len(self._worlds) \
+                            and not self._external_stop():
+                        # elastic resize: the drained checkpoint restores
+                        # at the next scheduled world, bit-exactly
+                        self._world_idx += 1
+                        continue
+                return self._report(last_report)
+            cause = self._root_cause(excs)
+            if self.restarts >= self.max_restarts:
+                raise cause
+            self.restarts += 1
+            publish_event("train_restart", attempt=self.restarts,
+                          world=world,
+                          error=f"{type(cause).__name__}: {cause}")
+            self.sleep(min(
+                self.backoff_s * self.backoff_factor ** (self.restarts - 1),
+                self.max_backoff_s))
+            # same topology: the next attempt's trainers restore the last
+            # committed step at entry and replay the tail deterministically
+
+    @staticmethod
+    def _root_cause(excs: List[BaseException]) -> BaseException:
+        """The exception worth propagating: a peer's CollectiveStallError
+        is collateral — the rank that actually died is the story."""
+        for e in excs:
+            if not isinstance(e, CollectiveStallError):
+                return e
+        return excs[0]
+
+    def _report(self, rank0_report: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            steps_retried = sum(tr.steps_retried
+                                for (_, r), tr in self._trainers.items()
+                                if r == 0)
+            skipped = max((tr._resilient.skipped_steps
+                           for tr in self._trainers.values()), default=0)
+        return {
+            "final_step": rank0_report["final_step"],
+            "preempted": rank0_report["preempted"],
+            "restarts": self.restarts,
+            "preempt_drains": self.preempt_drains,
+            "steps_retried": steps_retried,
+            "skipped_steps": skipped,
+            "hwm": self.hwm,
+            "worlds": list(self.world_history),
+            "goodput": self.telemetry.summary()["goodput"],
+        }
+
+    # ---- teardown -------------------------------------------------------
+    def params(self):
+        """The final parameter pytree (rank 0's replica of the last world
+        that ran) — what the bit-exactness oracles compare."""
+        with self._lock:
+            return self._trainers[(self.world_history[-1], 0)].params
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._lock:
+            trainers = list(self._trainers.values())
+        for tr in trainers:
+            tr.close()
+        self.telemetry.close()
+        if self._main_guard is not None:
+            self._main_guard.restore()
+            self._main_guard = None

@@ -3,7 +3,13 @@
 Mirrors the reference's runnable-examples convention
 (/root/reference/examples/simple): a GPT-2-tiny backbone trained with
 ``transformer.linear_cross_entropy`` — the chunked-vocab head whose
-logits never materialize in HBM — updated by FusedAdam.
+logits never materialize in HBM — updated by fused Adam.
+
+Since PR 14 the hand-rolled loop is gone: the example drives the
+production :class:`apex_tpu.train.Trainer` (docs/training.md) with a
+custom ``loss_fn`` — the trainer owns the step, the atomic checkpoints,
+the preemption guard, the watchdog, and the telemetry/goodput
+accounting; this file is the config plus three print callbacks.
 
 Run (CPU or TPU):
     JAX_PLATFORMS=cpu python examples/lm_pretrain/main_fused_head.py \
@@ -22,14 +28,15 @@ behavior on one host, so the same flag works from laptop to pod.
 With ``--telemetry-jsonl PATH`` every step emits a telemetry row
 (``{step, loss, grad_norm, loss_scale, step_ms, tokens_per_s, mfu, ...}``)
 through ``apex_tpu.monitor.Telemetry`` — grad/param norms are collected
-inside the jitted grad computation, checkpoint saves are charged to the
-goodput ledger, and the run ends with a goodput summary line
+inside the jitted step, checkpoint saves are charged to the goodput
+ledger, and the run ends with a goodput summary line
 (docs/observability.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -65,7 +72,7 @@ def main():
     args = ap.parse_args()
 
     from apex_tpu.models.gpt2 import GPT2, GPT2Config
-    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.train import TrainConfig, Trainer
     from apex_tpu.transformer import linear_cross_entropy
 
     cfg = GPT2Config.tiny()
@@ -74,13 +81,11 @@ def main():
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(key, (args.batch, args.seq), 0,
                                 cfg.vocab_size, jnp.int32)
-
-    full = model.init(jax.random.PRNGKey(1), tokens)
-    params = full["params"]
+    params = model.init(jax.random.PRNGKey(1), tokens)["params"]
 
     # split the LM head (tied embedding) out: the fused head consumes
     # hidden states + the embedding matrix directly
-    def loss_fn(params):
+    def loss_fn(params, tokens):
         hidden = model.apply({"params": params}, tokens,
                              return_hidden=True)
         wte = params["wte"]  # (V, H) tied LM head
@@ -92,120 +97,76 @@ def main():
             tokens[:, 1:].reshape(-1), 0.0, None, args.vocab_chunk)
         return jnp.mean(loss)
 
-    opt = FusedAdam(params, lr=args.lr)
+    # the whole former hand-rolled loop, as config: checkpoint cadence,
+    # sharded/coordinated mode, watchdog, telemetry — the Trainer
+    # composes CheckpointManager + PreemptionGuard + CollectiveWatchdog
+    # + Telemetry exactly as this file used to wire by hand
+    config = TrainConfig(
+        steps=args.steps, batch=args.batch, seq=args.seq,
+        vocab=cfg.vocab_size, hidden=cfg.n_embd, lr=args.lr,
+        amp="off",  # this example trains unscaled bf16-first, as before
+        checkpoint_dir=args.ckpt_dir,
+        save_every=args.save_every if args.ckpt_dir else 0,
+        sharded_checkpoint=bool(args.sharded_ckpt),
+        max_to_keep=2,
+        telemetry_jsonl=args.telemetry_jsonl,
+        trace_jsonl=args.trace_jsonl,
+        watchdog_timeout_s=(args.watchdog_timeout or None))
 
-    @jax.jit
-    def grads_of(params):
-        from apex_tpu.monitor.metrics import collect_metrics
+    coordinator = None
+    if args.sharded_ckpt:
+        from apex_tpu.resilience import default_coordinator
+        coordinator = default_coordinator()
+        if coordinator.process_count > 1:
+            # multi-host: the trainer data-parallels over the batch (one
+            # micro-shard per process — world must divide the batch); on
+            # one host this stays exactly the single-shard loop
+            import dataclasses
+            world = coordinator.process_count
+            if args.batch % world:
+                raise SystemExit(
+                    f"--batch {args.batch} must be divisible by the "
+                    f"process count {world} for --sharded-ckpt "
+                    f"multi-host runs")
+            config = dataclasses.replace(config, world=world,
+                                         grad_shards=world)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        # in-graph metrics: the norms trace into this same jit; values
-        # leave as device scalars, nothing syncs until telemetry flushes
-        # (loss_scale=1.0 — this example trains unscaled bf16-first)
-        tm = collect_metrics(grads=grads, params=params, loss=loss,
-                             loss_scale=1.0)
-        return loss, grads, tm
+    losses = []
 
-    telemetry = None
-    if args.telemetry_jsonl or args.trace_jsonl:
-        from apex_tpu.monitor import Telemetry
-        telemetry = Telemetry(args.telemetry_jsonl,
-                              tokens_per_step=args.batch * args.seq,
-                              trace_jsonl=args.trace_jsonl)
-        telemetry.calibrate(grads_of, params)
+    def on_step(step, loss):
+        losses.append(loss)
+        print(f"step {step}: loss {loss:.4f}", flush=True)
 
-    # optional resilience: resumable atomic checkpoints + preemption guard.
-    # Console banners are rank-0 gated: an N-host run prints one resume/
-    # preempt line, not N interleaved ones (bus events fire on every rank).
-    rank0 = jax.process_index() == 0
-    manager = guard = watchdog = None
-    start_step = 0
-    if args.ckpt_dir:
-        import numpy as np
-
-        from apex_tpu.resilience import CheckpointManager, PreemptionGuard
-        if args.sharded_ckpt:
-            from apex_tpu.resilience import (CollectiveWatchdog,
-                                             ShardedCheckpointManager,
-                                             default_coordinator)
-            coord = default_coordinator()
-            if args.watchdog_timeout:
-                watchdog = CollectiveWatchdog(
-                    timeout_s=args.watchdog_timeout, coordinator=coord)
-            manager = ShardedCheckpointManager(
-                args.ckpt_dir, max_to_keep=2, coordinator=coord,
-                watchdog=watchdog)
-            # coordinated: a SIGTERM on ANY host stops every process at
-            # the same step, so the final sharded save can commit
-            guard = PreemptionGuard(coordinator=coord).install()
-        else:
-            manager = CheckpointManager(args.ckpt_dir, max_to_keep=2)
-            guard = PreemptionGuard().install()
-        like = {"params": params, "opt": opt.state_dict(), "step": 0}
-        restored = manager.restore_latest(like)
-        if restored is not None:
-            _, tree = restored
-            params = tree["params"]
-            opt.load_state_dict(jax.tree_util.tree_map(np.asarray,
-                                                       tree["opt"]))
-            start_step = int(tree["step"]) + 1
-            if rank0:
-                print(f"resumed from step {start_step - 1}", flush=True)
-
-    def save(step, params):
-        manager.save(step, {"params": params, "opt": opt.state_dict(),
-                            "step": step})
-
-    l0 = loss = None
+    trainer = Trainer(config, coordinator=coordinator, loss_fn=loss_fn,
+                      init_params=params, batch_fn=lambda step: tokens,
+                      # guard only with a checkpoint dir (the pre-PR-14
+                      # behavior): without one there is nothing to save,
+                      # so a SIGTERM should just terminate the process
+                      install_signal_handlers=bool(args.ckpt_dir))
     try:
-        if telemetry is not None:
-            telemetry.start()
-        import contextlib
-
-        def span(name):
-            # per-step spans only when --trace-jsonl enabled a tracer:
-            # each span also lands one mirrored JSONL event, and plain
-            # telemetry must keep its events low-rate
-            if telemetry is not None and telemetry.tracer is not None:
-                return telemetry.span(name)
-            return contextlib.nullcontext()
-
-        for step in range(start_step, args.steps):
-            with span("train_step"):
-                loss, grads, tm = grads_of(params)
-                params = opt.step(grads)
-            if telemetry is not None:
-                # the float(loss) print below is the loop's host sync; the
-                # logged metric values stay device arrays until flush
-                telemetry.log_step(step, metrics=tm)
-            if l0 is None:
-                l0 = float(loss)
-            print(f"step {step}: loss {float(loss):.4f}", flush=True)
-            if manager is not None and step % args.save_every == 0:
-                with span("checkpoint"):  # the trace's ckpt-stall leg
-                    save(step, params)  # stalls land in the goodput ledger
-            if guard is not None and guard.should_stop():
-                save(step, params)  # final synchronous save, then stop
-                if rank0:
-                    print(f"preempted: saved step {step}, exiting",
-                          flush=True)
-                return
-    finally:
-        if guard is not None:
-            guard.restore()
-        if watchdog is not None:
-            watchdog.stop()
-        if telemetry is not None:
-            telemetry.close()
-            import json
+        if args.telemetry_jsonl or args.trace_jsonl:
+            trainer.calibrate()  # MFU from the XLA cost model
+        report = trainer.run(
+            on_step=on_step,
+            on_resume=lambda step: print(f"resumed from step {step}",
+                                         flush=True),
+            on_preempt=lambda step: print(
+                f"preempted: saved step {step}, exiting", flush=True))
+        if trainer.telemetry is not None and (args.telemetry_jsonl
+                                              or args.trace_jsonl):
             print("telemetry:",
-                  json.dumps(telemetry.summary()["goodput"]), flush=True)
-    # l0 is the first loss seen by THIS process — only meaningful to
-    # compare once we have run at least two steps since (a resumed run may
-    # have had a single step left)
-    if args.steps - start_step >= 2 and loss is not None:
-        assert float(loss) < l0, "loss did not fall"
-        print(f"OK: fused-head LM loss fell {l0:.4f} -> {float(loss):.4f}")
+                  json.dumps(trainer.telemetry.summary()["goodput"]),
+                  flush=True)
+    finally:
+        trainer.close()
+    if report["preempted"]:
+        return
+    # only meaningful once THIS process ran at least two steps (a resumed
+    # run may have had a single step left)
+    if len(losses) >= 2:
+        assert losses[-1] < losses[0], "loss did not fall"
+        print(f"OK: fused-head LM loss fell {losses[0]:.4f} -> "
+              f"{losses[-1]:.4f}")
 
 
 if __name__ == "__main__":

@@ -636,6 +636,85 @@ def test_apx002_covers_fleet_registry_heartbeat_thread(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx005_covers_train_preempt_drain_stamp(tmp_path):
+    """PR-14 coverage proof: a trainer preemption drain whose
+    ``train_preempt_drain`` seconds are computed from ``time.time()``
+    deltas fires APX005 (an NTP step mid-drain would publish a skewed —
+    possibly negative — stall into the goodput ledger); the monotonic
+    spelling the real trainer stamps the drain with stays quiet."""
+    _fixture(tmp_path, "apex_tpu/train/trainer.py", """\
+        import time
+
+        def drain(save, publish_event, step):
+            t0 = time.time()
+            save(step)
+            publish_event("train_preempt_drain", step=step,
+                          seconds=time.time() - t0)
+        """)
+    active, _ = _run(tmp_path, "APX005")
+    assert len(active) == 1 and "monotonic" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "train" / "trainer.py"
+    good.write_text(textwrap.dedent("""\
+        import time
+
+        def drain(save, publish_event, step):
+            t0 = time.perf_counter()
+            save(step)
+            publish_event("train_preempt_drain", step=step,
+                          seconds=time.perf_counter() - t0)
+        """))
+    active, _ = _run(tmp_path, "APX005")
+    assert not active, [v.format() for v in active]
+
+
+def test_apx002_covers_supervisor_progress_table(tmp_path):
+    """PR-14 coverage proof: the train supervisor's progress table is
+    written from every rank thread — a lock-free read-modify-write fires
+    APX002 (two ranks reporting at once would lose updates and the
+    control thread's status view would lie); the real lock-disciplined
+    spelling stays quiet."""
+    _fixture(tmp_path, "apex_tpu/train/supervisor.py", """\
+        import threading
+
+        class TrainSupervisor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rank_status = {}
+
+            def begin_attempt(self):
+                with self._lock:
+                    self._rank_status.clear()
+
+            def report(self, rank, step):
+                # called from every rank thread — lock-free
+                self._rank_status[rank] = {"step": step}
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 1
+    assert "lock-free" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "train" / "supervisor.py"
+    good.write_text(textwrap.dedent("""\
+        import threading
+
+        class TrainSupervisor:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rank_status = {}
+
+            def begin_attempt(self):
+                with self._lock:
+                    self._rank_status.clear()
+
+            def report(self, rank, step):
+                with self._lock:
+                    self._rank_status[rank] = {"step": step}
+        """))
+    active, _ = _run(tmp_path, "APX002")
+    assert not active, [v.format() for v in active]
+
+
 # --------------------------------------------------- 3. suppressions
 
 def test_justified_suppression_suppresses_and_is_counted(tmp_path):

@@ -86,7 +86,15 @@ _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 # fleet tracing (PR 13): every promoted journey is a
                 # bad-outcome request the tail capture had to rescue —
                 # a 0 -> N promotion storm gates as a regression
-                "trace_promoted")
+                "trace_promoted",
+                # production trainer (PR 14): supervisor restarts,
+                # preemption drains, replayed steps, and recompiles are
+                # lost work — a 0 -> N (or 1 -> N) storm in a chaos
+                # capture is a regression the gate must catch, never a
+                # win ("restarts" deliberately plural: the fleet's
+                # "replica_restarted" counter keeps its own direction)
+                "restarts", "preempt_drains", "steps_retried",
+                "recompile")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated
