@@ -372,7 +372,9 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  heartbeat_ms: "float | None" = None,
                  trace_jsonl: "str | None" = None,
                  trace_sample: "float | None" = None,
-                 flight_recorder: "str | None" = None) -> None:
+                 flight_recorder: "str | None" = None,
+                 tp: int = 1,
+                 tp_sync: str = "exact") -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -399,6 +401,15 @@ def _serve_bench(steps: int, num_slots: int = 4,
     and ``check_regression`` gates them directly — the live scrape and
     this bench produce comparably gateable artifacts); ``--tenants N``
     labels the scripted workload round-robin for a per-tenant view.
+
+    ``--tp N`` shards the bench engine over an N-device mesh
+    (docs/serving.md "Tensor-parallel decode") — the serve_decode entry
+    then measures the SHARDED step's tokens/s (the scaling curve), the
+    mesh shape rides the ``workload`` provenance, and
+    ``check_regression`` refuses to gate across mesh shapes outright.
+    ``--tp-sync`` picks the per-layer collective mode (exact = the
+    bit-identical oracle; overlap/relaxed trade exactness for less or
+    hidden collective pressure).
     """
     import dataclasses
     import json
@@ -421,8 +432,21 @@ def _serve_bench(steps: int, num_slots: int = 4,
         plo, phi = _parse_prompt_lens(prompt_len)
     except ValueError as e:
         raise SystemExit(f"apex-tpu-bench: {e}")
-    # fleet flag matrix (PR-10 precedent: inert/contradictory flags are
-    # loud usage errors before any compile, never silent no-ops)
+    # tensor-parallel + fleet flag matrix (PR-10 precedent:
+    # inert/contradictory flags are loud usage errors before any
+    # compile, never silent no-ops)
+    if tp < 1:
+        raise SystemExit(f"apex-tpu-bench: --tp {tp} must be >= 1")
+    if tp > 1 and replicas > 1:
+        raise SystemExit(
+            f"apex-tpu-bench: --tp shards ONE engine over a mesh; "
+            f"--replicas {replicas} runs independent engines — a fleet "
+            f"of meshes is out of scope (pick one)")
+    if tp_sync != "exact" and tp == 1:
+        raise SystemExit(
+            f"apex-tpu-bench: --tp-sync {tp_sync} relaxes cross-rank "
+            f"synchronization; it needs --tp >= 2 (a single chip has "
+            f"no collectives to overlap or relax)")
     if replicas < 1:
         raise SystemExit(f"apex-tpu-bench: --replicas {replicas} must "
                          f"be >= 1")
@@ -529,6 +553,11 @@ def _serve_bench(steps: int, num_slots: int = 4,
         # workload (e.g. the 32-1024 mixed sweep) needs longer rope/wpe
         cfg = dataclasses.replace(cfg, n_positions=max_len)
     cfg = dataclasses.replace(cfg, compute_dtype=jnp.float32)
+    if cfg.n_head % tp:
+        # before paying for params: the mesh shards whole heads
+        raise SystemExit(
+            f"apex-tpu-bench: --tp {tp} must divide the bench model's "
+            f"n_head={cfg.n_head} (the serving mesh shards whole heads)")
     params = init_gpt2_params(cfg)
     try:
         # one param pytree shared by every replica (read-only): the
@@ -539,7 +568,8 @@ def _serve_bench(steps: int, num_slots: int = 4,
                                        temperature=0.0,
                                        page_size=page_size,
                                        num_pages=num_pages,
-                                       prefix_cache=prefix_cache),
+                                       prefix_cache=prefix_cache,
+                                       tp=tp, tp_sync=tp_sync),
                           seed=0)
                    for _ in range(replicas)]
     except ValueError as e:
@@ -764,6 +794,13 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          "replicas": replicas,
                          "hedge_ms": hedge_ms,
                          "heartbeat_ms": heartbeat_ms,
+                         # mesh shape provenance: a tp=2 capture's
+                         # tokens/s measures a sharded step (collective
+                         # latency included) — check_regression REFUSES
+                         # to gate it against a different mesh shape
+                         # (incomparable_entries), not merely flags it
+                         "tp": tp,
+                         "tp_sync": tp_sync if tp > 1 else None,
                          # trace provenance (PR-8 incomparable-config
                          # precedent): a traced capture pays host-side
                          # span work per request — it must never gate
@@ -992,6 +1029,17 @@ def main() -> None:
                                  "--replicas N one recorder per replica "
                                  "(PATH.rK, auto-dump on that replica's "
                                  "death) plus the fleet-plane PATH")
+            ap.add_argument("--tp", type=int, default=1,
+                            help="tensor-parallel mesh size: shard the "
+                                 "bench engine (params + KV pool on the "
+                                 "head axis) over N devices — the "
+                                 "serve_decode tokens/s scaling curve; "
+                                 "workload provenance records it so the "
+                                 "gate never compares mesh shapes")
+            ap.add_argument("--tp-sync", default="exact",
+                            choices=["exact", "overlap", "relaxed"],
+                            help="per-layer cross-rank sync under --tp "
+                                 ">= 2 (exact = bit-identical oracle)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -1012,7 +1060,8 @@ def main() -> None:
                          heartbeat_ms=args.heartbeat_ms,
                          trace_jsonl=args.trace_jsonl,
                          trace_sample=args.trace_sample,
-                         flight_recorder=args.flight_recorder)
+                         flight_recorder=args.flight_recorder,
+                         tp=args.tp, tp_sync=args.tp_sync)
         elif has_telemetry:
             import argparse
 

@@ -221,3 +221,151 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
         x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     return logits, cache
+
+
+def _psum_halves_into(part, resid, bias, axis_name, ln=None):
+    """TokenWeave overlap seam: one logical all-reduce of ``part``
+    ``[num_slots, e]`` split into two slot-half psums, each half's
+    residual add (+ optional row layer-norm) interleaved so the OTHER
+    half's collective can fly behind it under XLA's async-collective
+    scheduling. Row-wise ops make the halved compute bit-identical to
+    the full-width spelling, and an elementwise psum split along rows is
+    bit-identical to the unsplit psum — so "overlap" differs from plain
+    Megatron row-parallel only in schedule, never in value. Returns
+    ``(x, ln_x | None)``."""
+    half = part.shape[0] // 2
+    r1 = jax.lax.psum(part[:half], axis_name)
+    x1 = resid[:half] + r1 + bias
+    y1 = ln(x1) if ln is not None else None
+    r2 = jax.lax.psum(part[half:], axis_name)
+    x2 = resid[half:] + r2 + bias
+    y2 = ln(x2) if ln is not None else None
+    x = jnp.concatenate([x1, x2], axis=0)
+    return x, (jnp.concatenate([y1, y2], axis=0) if ln is not None
+               else None)
+
+
+def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
+                          cache, tokens, positions, write_mask, *,
+                          block_k=None, axis_name: str = "tp"):
+    """The PER-RANK body of the tensor-parallel single-token forward —
+    run under ``shard_map`` over the serving mesh (``apex_tpu.serve.tp``
+    owns the param layout and specs). Heads are sharded: this rank sees
+    ``n_head // tp`` heads' qkv columns, its slice of the KV cache's
+    head axis, and the replicated residual stream.
+
+    The rank-local arithmetic is :func:`gpt2_token_forward`'s, op for
+    op, on column slices (per-column matmul determinism is what the
+    bit-exactness claim rides on); the modes differ ONLY in how ranks
+    combine:
+
+    - ``sync="exact"``: ``all_gather`` (concatenation — no cross-rank
+      float add) of the attention heads and the MLP hidden slices, then
+      the full projection matmuls replicated. Bit-identical in fp32 to
+      the single-chip forward at equal ``block_k``.
+    - ``sync="overlap"``: Megatron row-parallel projections; each of the
+      two per-layer all-reduces is split into two slot-half psums
+      interleaved with the adjacent residual/norm compute (TokenWeave).
+      ±ulp vs exact (partial sums reorder float adds).
+    - ``sync="relaxed"``: the post-attention all-reduce is deferred —
+      ``ln_2``/MLP run on the rank's partially-synchronized residual and
+      ONE combined psum per layer lands attention + MLP together
+      (partially-synchronized activations; opt-in approximation).
+
+    Every mode re-synchronizes the residual stream by the end of each
+    layer, so ``ln_f`` and the logits matmul run replicated and the
+    returned logits are identical on every rank (the caller's
+    ``out_specs`` treat them as replicated).
+    """
+    from apex_tpu.serve.attention import cached_attention, paged_attention
+    from apex_tpu.serve.kv_cache import paged_write_token, write_token
+
+    paged = hasattr(cache, "page_table")
+    c = cfg
+    dt = c.compute_dtype
+    h_loc = c.n_head // tp
+    d = c.n_embd // c.n_head
+    p = params
+    pos = positions.astype(jnp.int32)
+
+    x = (p["wte"][tokens].astype(dt)
+         + p["wpe"][jnp.clip(pos, 0, c.n_positions - 1)].astype(dt))
+    for i in range(c.n_layer):
+        blk = p[f"h_{i}"]
+        y = _affine_layer_norm(x, blk["ln_1"]["weight"],
+                               blk["ln_1"]["bias"])
+        # local heads' q/k/v: the permuted kernel slice is exactly this
+        # rank's columns of the full projection, so each output column's
+        # dot product is the single-chip one
+        qkv = (y.astype(dt) @ blk["attn_qkv"]["kernel"].astype(dt)
+               + blk["attn_qkv"]["bias"].astype(dt))
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(-1, h_loc, d)
+        k = k.reshape(-1, h_loc, d)
+        v = v.reshape(-1, h_loc, d)
+        if paged:
+            cache = paged_write_token(cache, i, k, v, pos, write_mask)
+            o = paged_attention(q, cache.k[i], cache.v[i],
+                                cache.page_table, pos, block_k=block_k)
+        else:
+            cache = write_token(cache, i, k, v, pos, write_mask)
+            o = cached_attention(q, cache.k[i], cache.v[i], pos,
+                                 block_k=block_k)
+        out_b = blk["attn_out"]["bias"].astype(dt)
+        if sync == "exact":
+            # concatenate the heads across ranks, then the FULL output
+            # projection replicated: no float add crosses a rank
+            o_full = jax.lax.all_gather(o, axis_name, axis=1, tiled=True)
+            o_full = o_full.reshape(-1, c.n_embd)
+            x = x + (o_full.astype(dt)
+                     @ blk["attn_out"]["kernel"].astype(dt) + out_b)
+            y = _affine_layer_norm(x, blk["ln_2"]["weight"],
+                                   blk["ln_2"]["bias"])
+        else:
+            # row-parallel output projection: this rank's heads hit its
+            # rows of the kernel — a PARTIAL [num_slots, e] sum
+            attn_part = (o.reshape(-1, h_loc * d).astype(dt)
+                         @ blk["attn_out"]["kernel"].astype(dt))
+            if sync == "overlap":
+                x, y = _psum_halves_into(
+                    attn_part, x, out_b, axis_name,
+                    ln=lambda v_: _affine_layer_norm(
+                        v_, blk["ln_2"]["weight"], blk["ln_2"]["bias"]))
+            else:  # relaxed: defer the attention psum across the norm
+                y = _affine_layer_norm(x + attn_part + out_b,
+                                       blk["ln_2"]["weight"],
+                                       blk["ln_2"]["bias"])
+        # MLP, column-parallel fc (this rank's 4e/tp rows), mirroring
+        # transformer.fused_dense.dense_gelu_dense's primal ops exactly
+        h = jax.lax.dot_general(
+            y.astype(dt), blk["mlp_fc_w"].astype(dt),
+            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        h = h + blk["mlp_fc_b"].astype(jnp.float32)
+        a = jax.nn.gelu(h, approximate=False)
+        proj_b = blk["mlp_proj_b"].astype(jnp.float32).astype(dt)
+        if sync == "exact":
+            a_full = jax.lax.all_gather(a.astype(dt), axis_name, axis=1,
+                                        tiled=True)
+            m = jax.lax.dot_general(
+                a_full, blk["mlp_proj_w"].astype(dt),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            x = x + (m + blk["mlp_proj_b"].astype(jnp.float32)).astype(dt)
+        else:
+            mlp_part = jax.lax.dot_general(
+                a.astype(dt), blk["mlp_proj_w"].astype(dt),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dt)
+            if sync == "overlap":
+                x, _ = _psum_halves_into(mlp_part, x, proj_b, axis_name)
+            else:
+                # relaxed: ONE all-reduce lands the deferred attention
+                # partial and the MLP partial together; the residual
+                # stream is fully synchronized again at layer exit
+                x, _ = _psum_halves_into(attn_part + mlp_part, x,
+                                         out_b + proj_b, axis_name)
+    x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
+    logits = jax.lax.dot_general(
+        x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return logits, cache

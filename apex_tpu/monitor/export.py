@@ -478,7 +478,13 @@ def merge_snapshots(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     # numbers. Keys every input agrees on pass through; conflicting
     # values join with "|" so the guard flags the mix loudly.
     meta: Dict[str, Any] = {"merged_from": len(docs)}
-    for key in ("device_kind", "interpret_mode", "chip", "backend", "git"):
+    # "tp"/"tp_sync" ride along for tensor-parallel rank merges: the
+    # mesh shape is comparability provenance exactly like device_kind
+    # (check_regression refuses cross-mesh gates), and every rank of one
+    # mesh agrees on it so it passes through raw ("tp_rank" is per-file
+    # identity, deliberately NOT merged)
+    for key in ("device_kind", "interpret_mode", "chip", "backend", "git",
+                "tp", "tp_sync"):
         vals: List[Any] = []
         for doc in docs:
             m = doc.get("meta")

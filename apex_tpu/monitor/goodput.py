@@ -111,6 +111,12 @@ COUNTED_EVENTS = (
     # counted, because every promotion is a bad-outcome request (the
     # regression gate treats trace_promoted as lower-is-better)
     "serve_trace_promoted",
+    # tensor-parallel serving (serve.tp): an engine built its
+    # NamedSharding mesh — counted once per engine with the mesh
+    # provenance (tp, sync mode, heads per shard, the per-step
+    # collective contract) so postmortems can tell which mesh shape
+    # served a stream
+    "serve_tp_mesh_ready",
     # production trainer (apex_tpu.train): one supervisor warm restart
     # after a fatal step error (bounded by max_restarts), a sharded
     # checkpoint restored at a different data-parallel world size than it

@@ -68,7 +68,11 @@ def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
 
     For full-machine meshes on real hardware prefer
     :func:`make_topology_mesh`, which lets jax's mesh utilities pick an
-    ICI-contiguous device order."""
+    ICI-contiguous device order. The serving engine's tensor-parallel
+    mesh (:func:`apex_tpu.serve.tp.serving_mesh` — the 1-D ``"tp"``
+    axis its head-sharded decode lowers under) builds here with an
+    explicit device prefix, so tests pin which virtual CPU devices back
+    the mesh and a deployment passes its ICI slice."""
     devices = devices if devices is not None else jax.devices()
     n = int(np.prod(axis_sizes))
     if n > len(devices):

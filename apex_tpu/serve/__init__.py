@@ -43,6 +43,13 @@ one invariant:
   occupancy gauges) into an :class:`apex_tpu.monitor.export.MetricsRegistry`
   plus per-tick SLO burn-rate evaluation — the layer
   ``--metrics-port``/``--metrics-snapshot`` scrape and merge.
+- :mod:`~apex_tpu.serve.tp` — tensor-parallel serving: shard params and
+  the KV pool on the HEAD axis over a ``NamedSharding`` mesh and lower
+  the one decode step (and each prefill bucket) under ``shard_map`` —
+  one compile per mesh shape, with per-layer collectives overlapped
+  TokenWeave-style (``tp_sync="overlap"``) or relaxed
+  (``tp_sync="relaxed"``), and the default exact mode bit-identical in
+  fp32 to the single-chip engine at equal ``block_k``.
 - :mod:`~apex_tpu.serve.cli` — ``apex-tpu-serve``: load a model config,
   run a scripted or stdin request stream, print per-request stats.
 

@@ -52,15 +52,24 @@ NEG_INF = jnp.float32(-1e30)
 def resolve_block_k(max_len: int, heads: int, head_dim: int, dtype,
                     block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
-                    page_size: Optional[int] = None) -> int:
+                    page_size: Optional[int] = None,
+                    tp_shards: int = 1) -> int:
     """The decode KV-chunk size: explicit value (validated), else the
     autotuned winner for this (max_len, page_size, heads, head_dim,
-    dtype, chip), else the committed heuristic.
+    tp_shards, dtype, chip), else the committed heuristic.
 
     With a paged cache (``page_size`` set) the chunk must additionally
     divide ``page_size`` so every chunk's rows live inside one page —
     the fetch is then a single page gather plus a static slice, and the
     geometry the autotuner times is the true streamed working set.
+
+    ``tp_shards`` is the tensor-parallel mesh size the attention runs
+    under (1 = single chip): a sharded engine passes its PER-SHARD head
+    count as ``heads``, and the shard count is its own exact key axis —
+    the per-shard working set that the tuner times is a different
+    kernel instance than an unsharded engine with the same local head
+    count (collective pressure and VMEM headroom differ), so winners
+    never leak across mesh shapes.
     """
     if page_size is not None:
         ps = int(page_size)
@@ -93,7 +102,7 @@ def resolve_block_k(max_len: int, heads: int, head_dim: int, dtype,
     p = tuned_params(
         "decode_attention",
         (("max_len", int(max_len)), ("page_size", ps), ("heads", heads),
-         ("d", head_dim)),
+         ("d", head_dim), ("tp_shards", int(tp_shards))),
         {"block_k": decode_attention_block(unit)},
         dtype=dtype, interpret=interpret,
         validate=lambda pr: (pr["block_k"] > 0

@@ -34,6 +34,18 @@ so a repeated system prompt is prefilled once. The summary's
 ``prefix_hit_rate`` / ``peak_resident_tokens`` report what the pool
 bought; decode still compiles exactly once (``decode_compiles``).
 
+Tensor-parallel decode (docs/serving.md "Tensor-parallel decode"):
+``--tp N`` shards the ONE engine — params and the KV pool on the head
+axis — over an N-device ``NamedSharding`` mesh and lowers decode plus
+each prefill bucket under ``shard_map``; the default ``--tp-sync exact``
+mode is bit-identical to the single-chip engine (fp32, equal block_k),
+``overlap``/``relaxed`` trade ulps/accuracy for fewer or hidden
+collectives. One compile per mesh shape (``decode_compiles`` stays 1);
+with ``--metrics-snapshot PATH`` each rank's shard-local view lands at
+``PATH.tpK`` and the ``tools/metrics_merge.py`` fold at ``PATH.tp``.
+``--tp`` refuses to combine with ``--replicas > 1`` (a fleet of meshes
+is out of scope) and ``--tp-sync`` without a mesh is refused as inert.
+
 Live metrics and SLOs (docs/observability.md "Live metrics, SLOs, and
 fleet aggregation"): ``--metrics-port`` serves Prometheus text at
 ``/metrics`` + a mergeable JSON snapshot at ``/metrics.json`` while the
@@ -406,6 +418,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "still-queued requests as retriable "
                          "rejections, and finish in-flight ones before "
                          "exiting cleanly (needs --replicas >= 2)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel mesh size: shard params + the "
+                         "KV pool on the head axis over N devices and "
+                         "run decode/prefill under shard_map (must "
+                         "divide the model's n_head; default 1 = single "
+                         "chip; docs/serving.md 'Tensor-parallel "
+                         "decode')")
+    ap.add_argument("--tp-sync", default="exact",
+                    choices=["exact", "overlap", "relaxed"],
+                    help="per-layer cross-rank sync with --tp >= 2: "
+                         "exact (all-gather concatenation — "
+                         "bit-identical to the single-chip engine, the "
+                         "default), overlap (TokenWeave split psums "
+                         "interleaved with norm/residual compute), "
+                         "relaxed (ONE deferred all-reduce per layer; "
+                         "opt-in approximation)")
     ap.add_argument("--stdin", action="store_true",
                     help="read one token-id request per input line")
     ap.add_argument("--aot", action="store_true",
@@ -453,6 +481,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     if max_len < args.max_len:
         print(f"apex-tpu-serve: --max-len {args.max_len} clamped to the "
               f"model's n_positions={max_len}", file=sys.stderr)
+
+    # tensor-parallel flag matrix, BEFORE any params/compile work
+    # (PR-10 precedent: inert/contradictory combinations are loud usage
+    # errors, never silent no-ops)
+    if args.tp < 1:
+        print(f"apex-tpu-serve: --tp {args.tp} must be >= 1",
+              file=sys.stderr)
+        return 2
+    if cfg.n_head % args.tp:
+        print(f"apex-tpu-serve: --tp {args.tp} must divide the model's "
+              f"n_head={cfg.n_head} (the serving mesh shards whole "
+              f"heads)", file=sys.stderr)
+        return 2
+    if args.tp > 1 and args.replicas > 1:
+        print(f"apex-tpu-serve: --tp shards ONE engine over a mesh; "
+              f"--replicas {args.replicas} runs independent engines — a "
+              f"fleet of meshes is out of scope (pick one)",
+              file=sys.stderr)
+        return 2
+    if args.tp_sync != "exact" and args.tp == 1:
+        print(f"apex-tpu-serve: --tp-sync {args.tp_sync} relaxes "
+              f"cross-rank synchronization; it needs --tp >= 2 (a "
+              f"single chip has no collectives to overlap or relax)",
+              file=sys.stderr)
+        return 2
 
     # fleet flag matrix, BEFORE any params/compile work: an inert or
     # contradictory combination is a usage error that must fail in
@@ -621,12 +674,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                          temperature=args.temperature, top_k=args.top_k,
                          page_size=args.page_size,
                          num_pages=args.num_pages,
-                         prefix_cache=args.prefix_cache),
+                         prefix_cache=args.prefix_cache,
+                         tp=args.tp, tp_sync=args.tp_sync),
             seed=args.seed)
     except ValueError as e:
         # bad pool geometry (page_size vs max_len/block_k, undersized
-        # num_pages, prefix-cache without pages) is a usage error, not a
-        # crash: the engine's message says exactly what to fix
+        # num_pages, prefix-cache without pages) and an undersized
+        # device pool for --tp are usage errors, not crashes: the
+        # engine's message says exactly what to fix
         print(f"apex-tpu-serve: {e}", file=sys.stderr)
         return 2
 
@@ -713,6 +768,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             write_snapshot(metrics.registry, args.metrics_snapshot,
                            meta=metrics_meta)
+        if args.metrics_snapshot and engine.tp > 1:
+            # one mergeable snapshot PER TP RANK (PATH.tpK — the file a
+            # real multi-host rank would write itself) plus the
+            # metrics_merge fleet view at PATH.tp: the PR-10 seam used
+            # for its designed purpose. The scheduler-level serving
+            # registry above stays the per-request truth; the rank
+            # files carry the shard-local view (local KV bytes, local
+            # heads, collective traffic) that sums to the engine totals
+            from apex_tpu.monitor.export import (atomic_write_json,
+                                                 merge_snapshots)
+
+            docs = engine.tp_rank_snapshots(meta=metrics_meta)
+            for r, doc in enumerate(docs):
+                atomic_write_json(f"{args.metrics_snapshot}.tp{r}", doc)
+            atomic_write_json(f"{args.metrics_snapshot}.tp",
+                              merge_snapshots(docs))
         if flight is not None:
             flight.detach()
         if router is not None:
@@ -725,6 +796,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     final = {"summary": stats.summary(),
              "decode_compiles": engine.decode_traces,
              "prefill_compiles": engine.prefill_traces}
+    if engine.tp > 1:
+        # mesh provenance + the per-step collective contract: one
+        # compile per MESH SHAPE is the invariant decode_compiles
+        # witnesses above
+        final["tp"] = {"tp": engine.tp, "sync": args.tp_sync,
+                       "collectives_per_decode_step":
+                           engine.tp_collectives_per_step()}
     if router is not None:
         final["trace"] = {"sample_rate": router.sampler.rate,
                           "sample_seed": router.sampler.seed,

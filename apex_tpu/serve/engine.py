@@ -37,6 +37,26 @@ conservative, but it keeps admission the single choke point
 (``serve_page_alloc_fail`` accounts the stall when the pool is the
 bottleneck).
 
+**Tensor-parallel mode** (``EngineConfig(tp=N)``) shards the whole
+engine over a 1-D ``NamedSharding`` mesh on the **head axis**: params
+(q/k/v columns, output-projection rows, MLP slices — see
+:mod:`apex_tpu.serve.tp`) and both cache layouts' K/V bytes shard per
+head block, while ``lengths``, the page table, and every scheduler-side
+structure stay replicated data — so the allocator, prefix index,
+journal, and scheduler are mesh-agnostic and the one-compile invariant
+becomes **one compile per mesh shape** (``decode_traces`` still reads
+1). The per-rank forward runs under ``shard_map`` inside the SAME
+jitted decode step and prefill scan; per-layer cross-rank sync is
+``tp_sync="exact"`` (all-gather concatenation — **bit-identical in fp32
+to the single-chip engine at equal ``block_k``**, greedy and sampled;
+the tier-1 oracle), ``"overlap"`` (TokenWeave: the two per-layer
+all-reduces each split into slot halves interleaved with norm/residual
+compute so async collectives hide behind compute on real chips), or
+``"relaxed"`` (partially-synchronized activations: ONE deferred
+all-reduce per layer; opt-in approximation). Sampling runs on the full
+replicated logits outside ``shard_map``, so the PRNG key path — and
+with it sampled-stream replay — is identical to a single chip.
+
 Sampling (temperature / top-k, greedy at ``temperature=0``) runs inside
 the jitted step under a threaded PRNG key: the key is part of engine
 state, split in-graph, and returned — a fixed seed replays a stream
@@ -56,12 +76,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from apex_tpu.models.gpt2 import GPT2Config, gpt2_token_forward
+from apex_tpu.models.gpt2 import (GPT2Config, gpt2_token_forward,
+                                  gpt2_token_forward_tp)
 from apex_tpu.ops.pallas.tiling import pow2_ceil
 from apex_tpu.serve import kv_cache, paging
+from apex_tpu.serve import tp as serve_tp
 from apex_tpu.serve.attention import resolve_block_k
-from apex_tpu.serve.kv_cache import init_cache, init_paged_cache
+from apex_tpu.serve.kv_cache import (init_cache, init_paged_cache,
+                                     shard_cache, tp_cache_specs)
 from apex_tpu.serve.paging import PagePool, PrefixIndex
+from apex_tpu.utils.compat import shard_map
+# bound at module import, NOT function-locally (the scheduler's
+# precedent): a sys.modules purge-and-reimport mid-process (the
+# test_chip_worker pattern) would otherwise make engine builds publish
+# to a FRESH event bus that collection-time subscribers never see
+from apex_tpu.utils.logging import publish_event
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,6 +115,20 @@ class EngineConfig:
     # keep per-position prefill logits (parity tests / scoring). O(P*B*V)
     # memory — leave False for real vocabularies.
     keep_prefill_logits: bool = False
+    # tensor-parallel mesh size (1 = single chip). Must divide n_head:
+    # the engine shards params and the KV pool on the HEAD axis over a
+    # 1-D NamedSharding mesh and lowers decode/prefill under shard_map —
+    # one compile per mesh shape (docs/serving.md "Tensor-parallel
+    # decode")
+    tp: int = 1
+    # per-layer cross-rank synchronization (tp >= 2 only): "exact" (the
+    # default and THE oracle — all-gather concatenation, bit-identical
+    # in fp32 to the single-chip engine at equal block_k), "overlap"
+    # (TokenWeave: row-parallel psums split in slot halves, interleaved
+    # with norm/residual compute), or "relaxed" (partially-synchronized
+    # activations: ONE deferred all-reduce per layer; opt-in
+    # approximation)
+    tp_sync: str = "exact"
 
 
 class Engine:
@@ -130,26 +173,73 @@ class Engine:
         elif config.num_pages is not None:
             raise ValueError("num_pages needs page_size (paged mode)")
         h, d = model_cfg.n_head, model_cfg.n_embd // model_cfg.n_head
+        # tensor-parallel mesh (docs/serving.md "Tensor-parallel
+        # decode"): every geometry error is a build-time ValueError,
+        # never a bad lowering
+        self._tp = int(config.tp)
+        if self._tp < 1:
+            raise ValueError(f"tp={config.tp} must be >= 1")
+        if config.tp_sync not in serve_tp.SYNC_MODES:
+            raise ValueError(
+                f"tp_sync={config.tp_sync!r} must be one of "
+                f"{serve_tp.SYNC_MODES}")
+        if self._tp == 1 and config.tp_sync != "exact":
+            raise ValueError(
+                f"tp_sync={config.tp_sync!r} relaxes cross-rank "
+                f"synchronization; it needs tp >= 2 (a single chip has "
+                f"no collectives to overlap or relax)")
+        if h % self._tp:
+            raise ValueError(
+                f"tp={self._tp} must divide n_head={h}: the serving "
+                f"mesh shards whole heads")
+        if self._tp > 1:
+            self.mesh: Optional[Any] = serve_tp.serving_mesh(self._tp)
+            self._tp_params, self._tp_param_specs = \
+                serve_tp.build_tp_params(model_cfg, params, self._tp,
+                                         config.tp_sync, self.mesh)
+            # the sharded tree is the ONLY param copy the compiled
+            # paths read; keeping the caller's full replicated tree
+            # alive too would pin a second whole-model copy for the
+            # engine's lifetime — for the model sizes TP exists for,
+            # that is the dominant memory cost duplicated
+            self.params = None
+        else:
+            self.mesh = None
+            self._tp_params = self._tp_param_specs = None
         # resolve the tuned geometry ONCE at engine build (cache lookups
         # at trace time inside scan would re-announce per position);
         # paged mode validates block_k against page_size here — a tuned
         # or explicit chunk that does not divide the page is a clear
-        # ValueError at build, never a bad gather at trace time
-        self.block_k = resolve_block_k(self.max_len, h, d,
+        # ValueError at build, never a bad gather at trace time. A
+        # sharded engine tunes at its PER-SHARD head count with the
+        # shard count as its own key axis (winners never leak across
+        # mesh shapes)
+        self.block_k = resolve_block_k(self.max_len, h // self._tp, d,
                                        model_cfg.compute_dtype,
                                        config.block_k,
-                                       page_size=config.page_size)
+                                       page_size=config.page_size,
+                                       tp_shards=self._tp)
         self._init_state(seed)
 
         # trace counters: tier-1 asserts decode_traces == 1 across a full
-        # admit/complete/evict/backfill trace (the one-jit invariant)
+        # admit/complete/evict/backfill trace (the one-jit invariant —
+        # one compile per MESH SHAPE: a tp engine's single decode trace
+        # covers every rank, there is no per-rank compile to count)
         self.decode_traces = 0
         self.prefill_traces = 0
 
         self._decode = jax.jit(self._decode_fn)
         self._decode_aot = None
+        self._decode_lowered = None    # kept so collective counting and
+        #                                postmortems never re-trace
         self._prefill_jits: Dict[int, Any] = {}
         self._prefill_aot: Dict[int, Any] = {}
+        if self._tp > 1:
+            publish_event(
+                "serve_tp_mesh_ready", tp=self._tp,
+                tp_sync=config.tp_sync, heads_per_shard=h // self._tp,
+                collectives_per_decode_step=sum(
+                    self.tp_collectives_per_step().values()))
 
     # ------------------------------------------------------------ graphs
     def _sample(self, logits, rng):
@@ -165,9 +255,30 @@ class Engine:
         return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
     def _token_step(self, cache, tokens, positions, mask):
-        return gpt2_token_forward(self.model_cfg, self.params, cache,
-                                  tokens, positions, mask,
-                                  block_k=self.block_k)
+        if self.mesh is None:
+            return gpt2_token_forward(self.model_cfg, self.params, cache,
+                                      tokens, positions, mask,
+                                      block_k=self.block_k)
+        # tensor-parallel: the SAME call sites (decode_fn, the prefill
+        # scan body) lower the per-rank forward under shard_map — the
+        # cache rides in head-sharded, the page table/lengths replicated,
+        # logits come back replicated (identical on every rank by the
+        # sync-mode contract), and sampling stays outside on the full
+        # replicated logits exactly as on a single chip
+        from jax.sharding import PartitionSpec as P
+
+        specs = tp_cache_specs(cache)
+
+        def rank_body(params, cache, tokens, positions, mask):
+            return gpt2_token_forward_tp(
+                self.model_cfg, self._tp, self.config.tp_sync, params,
+                cache, tokens, positions, mask, block_k=self.block_k)
+
+        fn = shard_map(rank_body, mesh=self.mesh,
+                       in_specs=(self._tp_param_specs, specs, P(), P(),
+                                 P()),
+                       out_specs=(P(), specs), check_vma=False)
+        return fn(self._tp_params, cache, tokens, positions, mask)
 
     def _decode_fn(self, cache, last_tokens, active, rng):
         self.decode_traces += 1          # python side effect: trace count
@@ -236,8 +347,12 @@ class Engine:
         from apex_tpu.monitor.memory import publish_compiled_memory
 
         if self._decode_aot is None:
-            self._decode_aot = self._decode.lower(
-                *self._decode_args()).compile()
+            # the lowering is kept: decode_collectives() counts the
+            # step's collective ops from it without ever re-tracing
+            # (a second .lower() would grow decode_traces)
+            self._decode_lowered = self._decode.lower(
+                *self._decode_args())
+            self._decode_aot = self._decode_lowered.compile()
             publish_compiled_memory(
                 "serve_decode", self._decode_aot,
                 num_slots=self.config.num_slots, max_len=self.max_len,
@@ -283,6 +398,10 @@ class Engine:
             self.prefix = None
             self._slot_pages = [[] for _ in range(b)]
             self._slot_capacity = np.full((b,), self.max_len, np.int64)
+        if self.mesh is not None:
+            # head-sharded K/V pools, replicated bookkeeping — placed at
+            # init so the compiled step never pays a layout move
+            self.cache = shard_cache(self.cache, self.mesh)
         self.rng = jax.random.PRNGKey(seed)
         self.last_tokens = np.zeros((b,), np.int32)
         # host mirror of cache.lengths (advanced deterministically by
@@ -291,6 +410,7 @@ class Engine:
         self._host_lengths = np.zeros((b,), np.int64)
         # prefix-cache accounting (tier-1 asserts a prefix hit SKIPS
         # prefill work via these, not via wall clock)
+        self.decode_calls = 0            # decode_step executions
         self.prefill_calls = 0           # host prefill() invocations
         self.prefill_requests = 0        # slot-prompts prefilled
         self.prefill_scanned_tokens = 0  # scan steps actually paid
@@ -581,6 +701,7 @@ class Engine:
         act = jnp.asarray(act_np)
         next_tokens, logits, self.cache, self.rng = fn(
             self.cache, lt, act, self.rng)
+        self.decode_calls += 1
         next_np = np.asarray(next_tokens)
         self.last_tokens = np.where(act_np, next_np, self.last_tokens)
         self._host_lengths = self._host_lengths + act_np
@@ -605,6 +726,42 @@ class Engine:
     @property
     def paged(self) -> bool:
         return self._paged
+
+    # ------------------------------------------------- tensor parallel
+    @property
+    def tp(self) -> int:
+        """Tensor-parallel mesh size (1 = single chip)."""
+        return self._tp
+
+    def tp_collectives_per_step(self) -> Dict[str, int]:
+        """The per-decode-step collective CONTRACT of this engine's sync
+        mode (zeros on a single chip); tier-1 holds it against the
+        actual lowering via :meth:`decode_collectives`."""
+        if self._tp == 1:
+            return {"all_gather": 0, "all_reduce": 0}
+        return serve_tp.expected_collectives(self.model_cfg.n_layer,
+                                             self.config.tp_sync)
+
+    def decode_collectives(self) -> Dict[str, int]:
+        """Collective ops in the ACTUAL lowered decode step (StableHLO
+        count — the verifier of :meth:`tp_collectives_per_step`). Uses
+        the saved AOT lowering, producing it first if needed — on an
+        engine already serving through the plain jit path, that
+        ``.lower()`` resolves from the jit's trace cache, so
+        ``decode_traces`` stays at 1 either way (tier-1 pins exactly
+        this ordering)."""
+        if self._decode_lowered is None:
+            self.aot_compile()
+        return serve_tp.count_collectives(self._decode_lowered.as_text())
+
+    def tp_rank_snapshots(self, meta: Optional[Dict[str, Any]] = None):
+        """Per-rank mergeable metrics snapshots (the PR-10
+        ``merge_snapshots`` seam) — see
+        :func:`apex_tpu.serve.tp.rank_snapshots`. Empty on a single
+        chip (there are no ranks to fold)."""
+        if self._tp == 1:
+            return []
+        return serve_tp.rank_snapshots(self, meta=meta)
 
     @property
     def resident_tokens(self) -> int:

@@ -225,6 +225,58 @@ def paged_write_token(cache: PagedKVCache, layer: int, k_tok: jax.Array,
     return cache.replace(k=out["k"], v=out["v"])
 
 
+# ------------------------------------------------- tensor-parallel layout
+#
+# Both cache layouts shard the SAME axis under tensor parallelism: axis 3
+# is `heads` in `[n_layer, num_slots, max_len, heads, head_dim]` and in
+# `[n_layer, num_pages, page_size, heads, head_dim]` alike. Everything
+# host-indexed — `lengths`, the page table, page/slot indices — stays
+# replicated data, which is why the allocator, prefix index, scheduler,
+# and journal are mesh-agnostic: a page index addresses every rank's
+# shard of that page simultaneously.
+
+
+def tp_cache_specs(cache, axis: str = "tp"):
+    """``PartitionSpec`` pytree for a TP-sharded cache: ``k``/``v`` on
+    the head axis, ``lengths`` (and the page table) replicated. Shaped
+    like the cache pytree itself, so it serves as ``shard_map``
+    in/out_specs and as the ``device_put`` placement recipe."""
+    from jax.sharding import PartitionSpec as P
+
+    kv = P(None, None, None, axis, None)
+    if hasattr(cache, "page_table"):
+        return PagedKVCache(k=kv, v=kv, lengths=P(), page_table=P())
+    return KVCache(k=kv, v=kv, lengths=P())
+
+
+def shard_cache(cache, mesh, axis: str = "tp"):
+    """Place a freshly-initialized cache onto the serving mesh per
+    :func:`tp_cache_specs` (head-sharded K/V pools, replicated
+    bookkeeping). Heads must divide over the mesh axis."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    heads = cache.k.shape[3]
+    tp = int(mesh.shape[axis])
+    if heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide n_head={heads}: the serving mesh "
+            f"shards whole heads (pick a tp that divides the head "
+            f"count)")
+    # ONE spelling of the layout: the placement derives from the same
+    # spec tree shard_map consumes, so the two can never drift
+    specs = tp_cache_specs(cache, axis)
+
+    def put(field):
+        return jax.device_put(getattr(cache, field),
+                              NamedSharding(mesh, getattr(specs, field)))
+
+    out = cache.replace(k=put("k"), v=put("v"), lengths=put("lengths"))
+    if hasattr(cache, "page_table"):
+        out = out.replace(page_table=put("page_table"))
+    return out
+
+
 # host-callable copy-on-write: ONE jitted op (page indices are traced
 # scalars), compiled once per engine — sharing a partially-used prefix
 # page costs a page copy, never a recompile

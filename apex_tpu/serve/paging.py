@@ -24,6 +24,12 @@ Invariants the engine relies on:
   position (the partial tail page is copied — copy-on-write — before it
   is written), and decode appends land past the prompt. Nothing enforces
   this on-device; the allocator's job is to make it structurally true.
+- **page indices are rank-invariant.** Under tensor parallelism the
+  pool's bytes shard on the HEAD axis (each mesh rank holds every
+  page's slice of its own heads), so one page index addresses all
+  ranks' shards of that page simultaneously — this ONE allocator, the
+  prefix index, and copy-on-write serve the whole mesh unchanged, and
+  the page table rides into the sharded step as replicated data.
 """
 
 from __future__ import annotations

@@ -7,6 +7,14 @@ admission queue -> slot assignment (batched prefill) -> decode -> per-slot
 termination (EOS / max new tokens / context full) -> eviction -> backfill
 from the queue -> next decode step.
 
+The scheduler is **mesh-agnostic**: a tensor-parallel engine
+(``EngineConfig(tp=N)``, docs/serving.md "Tensor-parallel decode")
+exposes the identical prefill/decode/evict surface — slot state, the
+queue, page tables, and the tick journal are all replicated host data,
+sharding lives entirely behind the engine's compiled calls — so
+everything here (admission control, deadlines, warm restart, metrics,
+tracing) runs unchanged over a mesh.
+
 Lifecycle events ride the PR-2 telemetry bus
 (:func:`apex_tpu.utils.logging.publish_event`) so a
 :class:`~apex_tpu.monitor.goodput.GoodputLedger` or Telemetry JSONL mirror

@@ -44,7 +44,11 @@ CODE_VERSIONS = {
     # v2: the paged KV pool added a page_size shape-key axis and the
     # block_k-divides-page constraint — entries tuned against the v1
     # slot-only geometry must not apply
-    "decode_attention": 2,
+    # v3: tensor-parallel serving added a tp_shards shape-key axis (the
+    # per-shard head count changes the best block shapes) — v2 entries,
+    # keyed without it, must invalidate rather than apply to a mesh
+    # shape they were never timed on
+    "decode_attention": 3,
     "fused_adam": 1,
     "fused_sgd": 1,
     "fused_lamb": 1,

@@ -341,10 +341,16 @@ def _da_shape_key(shape) -> ShapeKey:
     # chunk must live inside one page, so a winner tuned at one page size
     # cannot apply to another (or to the slot layout) — CODE_VERSIONS
     # bumped to 2 when this axis landed so v1 entries invalidate.
+    # tp_shards (1 = single chip) is a third: a tensor-parallel engine
+    # runs this kernel per mesh rank with `heads` = its PER-SHARD head
+    # count, and a winner timed unsharded must not apply to a sharded
+    # instance (or vice versa) — CODE_VERSIONS bumped to 3 with it so v2
+    # entries invalidate cleanly.
     return (("max_len", int(shape["max_len"])),
             ("page_size", int(shape.get("page_size", 0))),
             ("heads", int(shape["heads"])),
-            ("d", int(shape["d"])))
+            ("d", int(shape["d"])),
+            ("tp_shards", int(shape.get("tp_shards", 1))))
 
 
 def _da_unit(shape) -> int:

@@ -356,6 +356,58 @@ def check_device_kinds(current_path: str, baseline_path: str,
     return fail_on_mismatch
 
 
+# workload axes that make two captures of one entry INCOMPARABLE rather
+# than merely differently-shaped: a tensor-parallel capture's tokens/s
+# measures a sharded decode step (collective latency included) and its
+# per-rank HBM budget is 1/tp of the pool — gating it against a
+# single-chip baseline would be wrong in BOTH directions, so the gate
+# REFUSES the entry instead of comparing it. The sync mode is the same
+# kind of axis: a relaxed-sync capture runs half the collectives and
+# row-parallel matmuls — its tokens/s must never gate against an
+# exact-mode capture as a clean win. The dict value is the default for
+# captures that predate the axis (old baselines carry no "tp" key and
+# are single-chip by construction; tp_sync is stamped None off-mesh).
+INCOMPARABLE_WORKLOAD_KEYS = {"tp": 1, "tp_sync": None}
+
+
+def incomparable_entries(cur_doc: dict, base_doc: dict) -> Dict[str, str]:
+    """Suite entries whose nested ``workload`` provenance differs on an
+    incomparability axis — ``{entry_name: reason}``. Entries without
+    workload dicts on both sides (kernel benches, old formats) are never
+    refused here; absence of the axis means its default."""
+    out: Dict[str, str] = {}
+    for name, cur in cur_doc.items():
+        base = base_doc.get(name)
+        if not isinstance(cur, dict) or not isinstance(base, dict):
+            continue
+        wc, wb = cur.get("workload"), base.get("workload")
+        if not isinstance(wc, dict) or not isinstance(wb, dict):
+            continue
+        for key, default in INCOMPARABLE_WORKLOAD_KEYS.items():
+            a, b = wc.get(key, default), wb.get(key, default)
+            if a != b:
+                out[name] = (f"workload.{key}={a} vs baseline "
+                             f"workload.{key}={b}")
+                break    # first differing axis names the refusal
+    return out
+
+
+def _suite_doc(path: str) -> Optional[dict]:
+    """The raw suite-format document at ``path`` (None for JSONLs,
+    snapshots, and anything else ``incomparable_entries`` cannot read)."""
+    try:
+        with open(path) as f:
+            doc = json.loads(f.read())
+    except (ValueError, OSError):
+        return None
+    if not isinstance(doc, dict) \
+            or doc.get("schema") == METRICS_SNAPSHOT_SCHEMA:
+        return None
+    if any(isinstance(v, dict) and "value" in v for v in doc.values()):
+        return doc
+    return None
+
+
 def compare(current: Dict[str, Tuple[float, Optional[str]]],
             baseline: Dict[str, Tuple[float, Optional[str]]],
             tolerance: float, only: Optional[List[str]] = None) -> Tuple[List[dict], List[str]]:
@@ -471,6 +523,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         names = [k.strip() for k in args.kernels.split(",") if k.strip()]
         current = filter_kernels(current, names)
         baseline = filter_kernels(baseline, names)
+
+    # comparability guard: entries whose workload provenance differs on
+    # an incomparability axis (mesh shape) are REFUSED — dropped from
+    # BOTH sides with a loud line, so e.g. a tp=2 capture never gates
+    # its sharded tokens/s against a single-chip baseline (in either
+    # direction)
+    cur_doc, base_doc = _suite_doc(args.current), _suite_doc(baseline_path)
+    if cur_doc is not None and base_doc is not None:
+        for name, reason in sorted(
+                incomparable_entries(cur_doc, base_doc).items()):
+            print(f"INCOMPARABLE [{name}] {reason} — refusing to gate "
+                  f"this entry (different mesh shapes measure different "
+                  f"steps)")
+            current = {k: v for k, v in current.items()
+                       if k != name and k.split(".", 1)[0] != name}
+            baseline = {k: v for k, v in baseline.items()
+                        if k != name and k.split(".", 1)[0] != name}
 
     results, skipped = compare(current, baseline, args.tolerance,
                                args.metric)
