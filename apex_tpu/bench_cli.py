@@ -374,7 +374,10 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  trace_sample: "float | None" = None,
                  flight_recorder: "str | None" = None,
                  tp: int = 1,
-                 tp_sync: str = "exact") -> None:
+                 tp_sync: str = "exact",
+                 disagg: bool = False,
+                 roles: "str | None" = None,
+                 diurnal: bool = False) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -437,11 +440,6 @@ def _serve_bench(steps: int, num_slots: int = 4,
     # compile, never silent no-ops)
     if tp < 1:
         raise SystemExit(f"apex-tpu-bench: --tp {tp} must be >= 1")
-    if tp > 1 and replicas > 1:
-        raise SystemExit(
-            f"apex-tpu-bench: --tp shards ONE engine over a mesh; "
-            f"--replicas {replicas} runs independent engines — a fleet "
-            f"of meshes is out of scope (pick one)")
     if tp_sync != "exact" and tp == 1:
         raise SystemExit(
             f"apex-tpu-bench: --tp-sync {tp_sync} relaxes cross-rank "
@@ -450,6 +448,45 @@ def _serve_bench(steps: int, num_slots: int = 4,
     if replicas < 1:
         raise SystemExit(f"apex-tpu-bench: --replicas {replicas} must "
                          f"be >= 1")
+    # disaggregation matrix (PR-10 precedent, same as apex-tpu-serve)
+    role_split = None
+    if roles is not None and not disagg:
+        raise SystemExit(
+            "apex-tpu-bench: --roles splits a DISAGGREGATED fleet; it "
+            "needs --disagg")
+    if disagg:
+        if not page_size or not prefix_cache:
+            raise SystemExit(
+                "apex-tpu-bench: --disagg streams prompt pages through "
+                "the prefix index; it needs --page-size and "
+                "--prefix-cache")
+        if roles is not None:
+            pr, sep, de = str(roles).partition(":")
+            try:
+                role_split = (int(pr), int(de)) if sep else None
+            except ValueError:
+                role_split = None
+            if role_split is None or min(role_split) < 1:
+                raise SystemExit(
+                    f"apex-tpu-bench: --roles {roles!r}: want P:D "
+                    f"positive integers (e.g. 1:2)")
+            if replicas > 1 and replicas != sum(role_split):
+                raise SystemExit(
+                    f"apex-tpu-bench: --roles {roles} is a "
+                    f"{sum(role_split)}-replica fleet; --replicas "
+                    f"{replicas} contradicts it (drop one)")
+            replicas = sum(role_split)
+        else:
+            if replicas < 2:
+                raise SystemExit(
+                    "apex-tpu-bench: --disagg needs --replicas >= 2 "
+                    "(one prefill + at least one decode) or an "
+                    "explicit --roles P:D")
+            role_split = (1, replicas - 1)
+    if diurnal and replicas < 2:
+        raise SystemExit(
+            "apex-tpu-bench: --diurnal drives a FLEET through the "
+            "day curve; it needs --replicas >= 2 (or --disagg)")
     if replicas == 1 and (hedge_ms is not None
                           or heartbeat_ms is not None):
         raise SystemExit(
@@ -480,7 +517,13 @@ def _serve_bench(steps: int, num_slots: int = 4,
     # snapshots. Armed BEFORE the engines pay for params + compiles: an
     # inert --tenants or an unbindable port must fail in milliseconds
     metrics = exporter = registries = per_metrics = None
-    replica_ids = [f"r{i}" for i in range(replicas)]
+    if role_split:
+        replica_specs = [(f"p{i}", "prefill")
+                         for i in range(role_split[0])] \
+            + [(f"d{i}", "decode") for i in range(role_split[1])]
+    else:
+        replica_specs = [(f"r{i}", "unified") for i in range(replicas)]
+    replica_ids = [rid for rid, _ in replica_specs]
     if tenants > 0 and metrics_port is None and not metrics_snapshot:
         # the labels would reach no observable output — the armed-but-
         # inert flag class this PR makes a loud usage error everywhere
@@ -622,18 +665,20 @@ def _serve_bench(steps: int, num_slots: int = 4,
     recorders = []
     fleet_flight = single_flight = None
     if replicas > 1:
+        from apex_tpu.serve.disagg import DisaggController
         from apex_tpu.serve.fleet import EngineReplica, FleetController
 
         # CPU-tolerant death budget (2s at the default interval): a
         # fabricated death on a healthy bench fleet would stamp nonzero
         # failovers/replica_dead into lower-is-better gated counters —
         # flunking the regression gate off machine noise
-        fleet = FleetController(
+        fleet_cls = DisaggController if role_split else FleetController
+        fleet = fleet_cls(
             [EngineReplica(
-                rid, e, admission=_admission(),
+                rid, e, role=role, admission=_admission(),
                 metrics=per_metrics[rid] if per_metrics else None,
                 tracer=harness.tracer_for(rid) if harness else None)
-             for rid, e in zip(replica_ids, engines)],
+             for (rid, role), e in zip(replica_specs, engines)],
             heartbeat_ms=50.0 if heartbeat_ms is None else heartbeat_ms,
             suspect_misses=20, dead_misses=40, hedge_ms=hedge_ms,
             tracer=harness.fleet_tracer if harness else None)
@@ -645,8 +690,9 @@ def _serve_bench(steps: int, num_slots: int = 4,
             recorders = attach_fleet_recorders(fleet, flight_recorder,
                                                harness)
             fleet_flight = recorders[-1]
-        for spec in specs:
-            fleet.submit(spec)
+        if not diurnal:
+            for spec in specs:
+                fleet.submit(spec)
     else:
         if flight_recorder:
             from apex_tpu.monitor.flight import FlightRecorder
@@ -669,8 +715,43 @@ def _serve_bench(steps: int, num_slots: int = 4,
         # TimeoutError mid-bench
         with (fleet_flight.guard("fleet") if fleet_flight is not None
               else contextlib.nullcontext()):
-            stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(specs))) \
-                if fleet is not None else sched.run(max_steps=steps)
+            if fleet is not None and diurnal:
+                # one compressed "day": requests arrive along the
+                # seeded sinusoidal curve (trough -> peak -> trough)
+                # while the control loop pumps, then the fleet finishes
+                # the backlog — total volume sized to the --steps
+                # workload so the entry stays comparable in scale
+                from apex_tpu.serve.disagg import DiurnalTraffic
+
+                day_s = 2.0
+                traffic = DiurnalTraffic(
+                    day_s=day_s, seed=0,
+                    capacity_scale=(len(specs) / day_s)
+                    / (2_000_000 * 8.0 / 86400.0),
+                    prompt_lens=list(range(plo, phi + 1)),
+                    max_new_tokens=8, vocab=cfg.vocab_size,
+                    id_prefix="bench-diurnal")
+                fleet.start()
+                traffic.start()
+                t_end = time.perf_counter() + day_s
+                while time.perf_counter() < t_end:
+                    for r in traffic.due():
+                        if system or deadline_ms is not None \
+                                or tenants > 0:
+                            r = dataclasses.replace(
+                                r, tokens=system + list(r.tokens),
+                                deadline_ms=deadline_ms,
+                                tenant=f"tenant-{traffic.emitted % tenants}"
+                                if tenants > 0 else None)
+                        fleet.submit(r)
+                    fleet.pump()
+                    time.sleep(0.002)
+                stats = fleet.run(
+                    max_wall_s=max(60.0, 2.0 * max(traffic.emitted, 1)))
+            elif fleet is not None:
+                stats = fleet.run(max_wall_s=max(60.0, 2.0 * len(specs)))
+            else:
+                stats = sched.run(max_steps=steps)
         # measured BEFORE the finally teardown: exporter.stop() blocks on
         # the HTTP server's shutdown poll + thread join + snapshot I/O,
         # and bench_wall_s gates lower-is-better — teardown noise must
@@ -755,6 +836,13 @@ def _serve_bench(steps: int, num_slots: int = 4,
                 "replica_dead": s["replica_dead"],
                 "migrations": s["migrations"]}
                if fleet is not None else {}),
+            # disaggregated captures only: refused handoffs are
+            # certification failures (lower-is-better, the gate knows
+            # "handoff_refused"); pages_migrated is the streaming
+            # volume the refusal rate is read against
+            **({"handoff_refused": s["handoffs_refused"],
+                "pages_migrated": s["pages_migrated"]}
+               if role_split else {}),
             # traced captures only (lower-is-better; the gate knows):
             # every promoted journey is a bad-outcome request the tail
             # capture had to rescue — untraced baselines simply skip it
@@ -801,6 +889,15 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          # (incomparable_entries), not merely flags it
                          "tp": tp,
                          "tp_sync": tp_sync if tp > 1 else None,
+                         # disaggregation provenance: a disaggregated
+                         # (or diurnal-arrival) capture measures a
+                         # different serving pipeline — the gate
+                         # REFUSES to compare across these axes
+                         # (incomparable_entries), not merely flags it
+                         "disagg": bool(role_split),
+                         "roles": f"{role_split[0]}:{role_split[1]}"
+                         if role_split else None,
+                         "diurnal": bool(diurnal),
                          # trace provenance (PR-8 incomparable-config
                          # precedent): a traced capture pays host-side
                          # span work per request — it must never gate
@@ -886,6 +983,15 @@ def main() -> None:
         # (the serve bench has no event mirror; swallowing the flag
         # would be the silent-no-op class this matrix refuses)
         has_serve = any(a == "--serve" for a in sys.argv[1:])
+        serve_only = [a for a in sys.argv[1:]
+                      if a.split("=", 1)[0] in ("--disagg", "--roles",
+                                                "--diurnal")]
+        if serve_only and not has_serve:
+            # without --serve these would silently fall through to the
+            # kernel bench — the inert-flag class this matrix refuses
+            print(f"apex-tpu-bench: {serve_only[0]} shapes the serving "
+                  f"bench; it needs --serve", file=sys.stderr)
+            sys.exit(2)
         has_train_chaos = any(a == "--train-chaos" for a in sys.argv[1:])
         has_telemetry = any(
             a.split("=", 1)[0] == "--telemetry-jsonl"
@@ -1040,6 +1146,21 @@ def main() -> None:
                             choices=["exact", "overlap", "relaxed"],
                             help="per-layer cross-rank sync under --tp "
                                  ">= 2 (exact = bit-identical oracle)")
+            ap.add_argument("--disagg", action="store_true",
+                            help="disaggregated prefill/decode fleet: "
+                                 "dedicated prefill replicas stream "
+                                 "certified KV pages into the decode "
+                                 "pool (needs --page-size + "
+                                 "--prefix-cache and --replicas >= 2 "
+                                 "or --roles)")
+            ap.add_argument("--roles", default=None, metavar="P:D",
+                            help="prefill:decode replica split (needs "
+                                 "--disagg; default 1:(replicas-1))")
+            ap.add_argument("--diurnal", action="store_true",
+                            help="drive the fleet through one seeded "
+                                 "compressed diurnal day instead of an "
+                                 "upfront burst (needs --replicas >= 2 "
+                                 "or --disagg)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -1061,7 +1182,9 @@ def main() -> None:
                          trace_jsonl=args.trace_jsonl,
                          trace_sample=args.trace_sample,
                          flight_recorder=args.flight_recorder,
-                         tp=args.tp, tp_sync=args.tp_sync)
+                         tp=args.tp, tp_sync=args.tp_sync,
+                         disagg=args.disagg, roles=args.roles,
+                         diurnal=args.diurnal)
         elif has_telemetry:
             import argparse
 

@@ -74,6 +74,12 @@ STALL_EVENTS = {
     # productive — the supervisor's job-scope high-water mark guarantees
     # each step index lands in the ledger as productive exactly once
     "train_step_replayed": "train_replay",
+    # disaggregated serving: wall time a request spent waiting on its
+    # prefill→decode KV page handoff (creation → delivery / refusal /
+    # abandonment) — the transfer latency TokenWeave-style overlap must
+    # hide; a stalled interconnect shows up here, never as a silent TTFT
+    # regression
+    "serve_handoff_wait": "serve_handoff_wait",
 }
 
 # counted (not timed) degradation signals from the resilience subsystem
@@ -123,6 +129,14 @@ COUNTED_EVENTS = (
     # was saved under (the elastic-resize signal), and each committed
     # checkpoint (rank 0 publishes once per commit/resize/restart)
     "train_restart", "train_elastic_resized", "train_checkpoint_commit",
+    # disaggregated serving (apex_tpu.serve.disagg): one migrated KV
+    # page landed certified in a decode pool; one handoff refused on
+    # arrival (chain-hash / payload-digest mismatch — the request fell
+    # back to local re-prefill); one replica spawned into a running
+    # fleet; one autoscaler action per direction (hysteresis + cooldown
+    # bound these — a flapping autoscaler shows up as a count storm)
+    "serve_page_migrated", "serve_handoff_refused",
+    "serve_replica_spawned", "serve_autoscale_up", "serve_autoscale_down",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

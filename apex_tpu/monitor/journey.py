@@ -45,6 +45,7 @@ SERVE_TIMED_CAUSES = {
     "serve_request_rejected": "serve_rejected",
     "serve_page_alloc_fail": "serve_page_alloc_fail",
     "serve_failover": "serve_failover",
+    "serve_handoff_wait": "serve_handoff_wait",
 }
 
 # journey trace ids: "journey:<request_id>" (fleet) / "request:<request_id>"

@@ -47,6 +47,18 @@ Production failure modes, reproduced on a laptop with a seed:
   fleet smoke runs kill + partition + straggler in one seeded schedule
   and asserts every submitted request reaches exactly one terminal
   status fleet-wide.
+- **Disaggregation chaos** — faults on the prefill→decode KV page
+  handoff (:mod:`apex_tpu.serve.disagg`):
+  ``kill_prefill_replica(rid, at_tick)`` kills a prefill replica with
+  handoffs possibly in flight (abandoned handoffs fall back to local
+  re-prefill, bit-exactly), ``corrupt_page_in_flight(nth)`` flips one
+  bit in the nth migrated page transfer (the receiver's digest
+  certification must refuse it — ``serve_handoff_refused`` — never
+  decode from it), and ``stall_handoff(delay_s, at_handoff)`` defers
+  one handoff's delivery (a slow interconnect; charges
+  ``serve_handoff_wait``). The tier-1 disaggregation smoke mixes all
+  three in one seeded schedule and asserts greedy completions
+  bit-identical to a no-fault unified fleet.
 - **Trainer chaos** — step-level failure for the production trainer
   (:mod:`apex_tpu.train`): ``crash_on_train_step(at_step)`` raises
   :class:`SimulatedCrash` the instant a rank would run that train step
@@ -169,6 +181,12 @@ class FaultInjector:
         self._replica_kills: Dict[str, int] = {}
         self._partitions: Dict[str, List[int]] = {}    # [start, end)
         self._replica_straggles: Dict[str, List[float]] = {}
+        # disaggregation chaos (page-transfer / handoff ordinals, 1-based
+        # across the fleet's lifetime)
+        self._page_corruptions: set = set()            # nth migrated page
+        self._page_transfer_count = 0
+        self._handoff_stalls: Dict[int, float] = {}    # nth handoff -> s
+        self._handoff_count = 0
         # trainer chaos (train-step units / checkpoint step numbers)
         self._train_crashes: Dict[int, int] = {}       # step -> remaining
         self._ckpt_crash_steps: set = set()            # checkpoint steps
@@ -432,6 +450,59 @@ class FaultInjector:
         if ent and ent[0] <= tick < ent[1]:
             return ent[2]
         return 0.0
+
+    # ---- disaggregated serving: handoff chaos ---------------------------
+    def kill_prefill_replica(self, replica_id: Any,
+                             at_tick: int = 1) -> "FaultInjector":
+        """Kill a PREFILL replica's worker at its ``at_tick``-th tick —
+        the disaggregation death scenario: prompts it already committed
+        may be mid-handoff (the controller abandons them and the decode
+        replica re-prefills locally, bit-exactly), and prompts it never
+        reached dispatch without pages. Mechanically the same one-shot
+        as :meth:`kill_replica`; the dedicated name keeps chaos
+        schedules self-describing."""
+        return self.kill_replica(replica_id, at_tick)
+
+    def corrupt_page_in_flight(self, nth: int = 1,
+                               count: int = 1) -> "FaultInjector":
+        """Flip one bit in migrated KV page transfers ``nth ..
+        nth+count-1`` (1-based, counted across every handoff the fleet
+        delivers). The receiver's payload-digest certification must
+        refuse the page (``serve_handoff_refused``) and the request must
+        complete bit-exactly via local re-prefill — never decode from
+        the corrupted bytes."""
+        for n in range(int(nth), int(nth) + int(count)):
+            self._page_corruptions.add(n)
+        return self
+
+    def page_corrupt_due(self) -> bool:
+        """Consumed by the disaggregation controller once per page
+        transfer, in delivery order: True when THIS transfer should be
+        corrupted in flight."""
+        self._page_transfer_count += 1
+        if self._page_transfer_count in self._page_corruptions:
+            self._page_corruptions.discard(self._page_transfer_count)
+            return True
+        return False
+
+    def stall_handoff(self, delay_s: float,
+                      at_handoff: int = 1) -> "FaultInjector":
+        """Delay delivery of the ``at_handoff``-th committed handoff
+        (1-based, fleet lifetime order) by ``delay_s`` — a slow
+        interconnect between the prefill and decode pools. The
+        controller defers delivery (no sleep — the stall charges
+        ``serve_handoff_wait``, it must not wedge the control thread),
+        and a stalled handoff racing a drain or a death must still
+        settle exactly once."""
+        self._handoff_stalls[int(at_handoff)] = float(delay_s)
+        return self
+
+    def handoff_stall_due(self) -> float:
+        """Consumed by the disaggregation controller once per committed
+        handoff, in commit order: seconds this handoff's delivery should
+        be deferred (0.0 = deliver on the next pump)."""
+        self._handoff_count += 1
+        return self._handoff_stalls.pop(self._handoff_count, 0.0)
 
     # ---- trainer chaos --------------------------------------------------
     def crash_on_train_step(self, at_step: int,

@@ -43,8 +43,10 @@ mode is bit-identical to the single-chip engine (fp32, equal block_k),
 collectives. One compile per mesh shape (``decode_compiles`` stays 1);
 with ``--metrics-snapshot PATH`` each rank's shard-local view lands at
 ``PATH.tpK`` and the ``tools/metrics_merge.py`` fold at ``PATH.tp``.
-``--tp`` refuses to combine with ``--replicas > 1`` (a fleet of meshes
-is out of scope) and ``--tp-sync`` without a mesh is refused as inert.
+``--tp`` composes with ``--replicas N`` as a **fleet of meshes**: each
+replica owns its own N-device ``NamedSharding`` mesh (one compile per
+mesh shape; per-rank metrics fold through the same merge). ``--tp-sync``
+without a mesh is still refused as inert.
 
 Live metrics and SLOs (docs/observability.md "Live metrics, SLOs, and
 fleet aggregation"): ``--metrics-port`` serves Prometheus text at
@@ -91,6 +93,20 @@ Only ``--max-restarts`` remains single-scheduler wiring (exit 2 with
 never silent no-ops; ``--trace-sample`` without ``--trace-jsonl`` is
 equally inert and refused.
 
+Disaggregated prefill/decode (docs/serving.md "Disaggregated
+prefill/decode"): ``--roles P:D`` splits the fleet into P dedicated
+prefill replicas and D decode replicas (``--replicas``, if given, must
+equal P+D). Prefill replicas run the bucketed prefill and stream the
+committed prompt pages into a decode replica's pool; every migrated
+page is certified on arrival against the prompt's own chain hashes — a
+corrupt or torn transfer refuses the handoff and the decode replica
+re-prefills locally, bit-exact. Requires ``--page-size`` +
+``--prefix-cache`` (pages move through the prefix index).
+``--autoscale`` arms the SLO-driven control loop (needs ``--slo`` —
+the burn rate is its up signal) scaling the decode pool between
+``--min-replicas`` and ``--max-replicas`` by rolling drain / warm
+restart; both bounds are inert (exit 2) without it.
+
 Example::
 
     apex-tpu-serve --config tiny --requests 4 --max-new-tokens 8 \
@@ -105,6 +121,21 @@ import sys
 from typing import List, Optional
 
 import numpy as np
+
+
+def _parse_roles(spec):
+    """``"P:D"`` -> ``(P, D)`` with both >= 1, else None (bad spec or
+    no spec — the caller owns the usage error)."""
+    if spec is None:
+        return None
+    p, sep, d = str(spec).partition(":")
+    if not sep:
+        return None
+    try:
+        roles = (int(p), int(d))
+    except ValueError:
+        return None
+    return roles if roles[0] >= 1 and roles[1] >= 1 else None
 
 
 def _parse_line(line: str) -> List[int]:
@@ -130,13 +161,22 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
     recorder guarding the control loop."""
     import signal as signal_mod
 
+    from apex_tpu.serve.disagg import Autoscaler, DisaggController
     from apex_tpu.serve.engine import (Engine, EngineConfig,
                                        init_gpt2_params)
     from apex_tpu.serve.fleet import (EngineReplica, FleetController,
                                       FleetTraceHarness)
     from apex_tpu.serve.scheduler import Request
 
-    replica_ids = [f"r{i}" for i in range(args.replicas)]
+    roles = _parse_roles(args.roles)
+    if roles:
+        # pK prefill the prompts and stream pages; dK decode the streams
+        replica_specs = [(f"p{i}", "prefill") for i in range(roles[0])] \
+            + [(f"d{i}", "decode") for i in range(roles[1])]
+    else:
+        replica_specs = [(f"r{i}", "unified")
+                         for i in range(args.replicas)]
+    replica_ids = [rid for rid, _ in replica_specs]
     want_metrics = bool(args.metrics_snapshot) or slo is not None \
         or args.metrics_port is not None
     metrics_meta = registries = exporter = None
@@ -173,17 +213,19 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
             sample_seed=args.seed)
 
     params = init_gpt2_params(cfg, seed=args.seed)
+    # fleet of meshes: with --tp >= 2 EVERY replica shards its own
+    # engine over its own NamedSharding mesh (one compile per mesh
+    # shape; per-rank metrics fold through the same snapshot merge)
+    engine_cfg = EngineConfig(num_slots=args.num_slots, max_len=max_len,
+                              temperature=args.temperature,
+                              top_k=args.top_k, page_size=args.page_size,
+                              num_pages=args.num_pages,
+                              prefix_cache=args.prefix_cache,
+                              tp=args.tp, tp_sync=args.tp_sync)
     handles = []
-    for i, rid in enumerate(replica_ids):
+    for i, (rid, role) in enumerate(replica_specs):
         try:
-            engine = Engine(
-                cfg, params,
-                EngineConfig(num_slots=args.num_slots, max_len=max_len,
-                             temperature=args.temperature,
-                             top_k=args.top_k, page_size=args.page_size,
-                             num_pages=args.num_pages,
-                             prefix_cache=args.prefix_cache),
-                seed=args.seed)
+            engine = Engine(cfg, params, engine_cfg, seed=args.seed)
         except ValueError as e:
             print(f"apex-tpu-serve: {e}", file=sys.stderr)
             if exporter is not None:
@@ -205,7 +247,8 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                 if slo is not None else None
             metrics = ServeMetrics(registry=registries[rid], slo=tracker)
         handles.append(EngineReplica(
-            rid, engine, admission=admission, metrics=metrics,
+            rid, engine, role=role, admission=admission,
+            metrics=metrics,
             tracer=harness.tracer_for(rid) if harness is not None
             else None))
     # ALWAYS pre-compile in fleet mode (--aot is implied): a prefill or
@@ -236,12 +279,43 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
     # stall far past a tight window; fabricated deaths would duplicate
     # work via failover on a perfectly healthy fleet. Operators trade
     # detection latency via --heartbeat-ms (the budget scales with it).
-    fleet = FleetController(
+    # DisaggController degrades to the base router with no prefill
+    # replicas, so it also carries the autoscaler hook for unified
+    # fleets; the plain FleetController path stays byte-identical when
+    # neither feature is armed
+    fleet_cls = DisaggController if (roles or args.autoscale) \
+        else FleetController
+    fleet = fleet_cls(
         handles,
         heartbeat_ms=50.0 if args.heartbeat_ms is None
         else args.heartbeat_ms,
         suspect_misses=20, dead_misses=40, hedge_ms=args.hedge_ms,
         tracer=harness.fleet_tracer if harness is not None else None)
+    if args.autoscale:
+        scale_role = "decode" if roles else "unified"
+        decode_n = roles[1] if roles else args.replicas
+        spawn_seq = [len(replica_specs)]
+
+        def _spawn():
+            # cold spawn: a fresh engine on the shared params, warmed
+            # over the same buckets (the warm-restart standby path is
+            # preferred by the autoscaler and never reaches here).
+            # Spawned replicas serve without a per-replica metrics
+            # registry: the merged snapshot covers the starting fleet.
+            idx = spawn_seq[0]
+            spawn_seq[0] += 1
+            eng = Engine(cfg, params, engine_cfg, seed=args.seed)
+            eng.aot_compile(buckets)
+            return EngineReplica(f"{'d' if roles else 'r'}{idx}", eng,
+                                 role=scale_role)
+
+        fleet.autoscaler = Autoscaler(
+            fleet, role=scale_role,
+            min_replicas=1 if args.min_replicas is None
+            else args.min_replicas,
+            max_replicas=decode_n if args.max_replicas is None
+            else args.max_replicas,
+            factory=_spawn)
     recorders = []
     fleet_flight = None
     if args.flight_recorder:
@@ -396,7 +470,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--slo-window", default=None, metavar="SHORT:LONG",
                     help="burn-rate window spans in seconds "
                          "(default 60:300)")
-    ap.add_argument("--replicas", type=int, default=1,
+    ap.add_argument("--replicas", type=int, default=None,
                     help="run N thread-backed engine replicas under the "
                          "fleet controller (heartbeat health, failover "
                          "re-dispatch, hedging; default 1 = the single "
@@ -418,6 +492,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "still-queued requests as retriable "
                          "rejections, and finish in-flight ones before "
                          "exiting cleanly (needs --replicas >= 2)")
+    ap.add_argument("--roles", default=None, metavar="P:D",
+                    help="disaggregate the fleet: P dedicated prefill "
+                         "replicas streaming certified KV pages into D "
+                         "decode replicas (needs --page-size + "
+                         "--prefix-cache; --replicas, if given, must "
+                         "equal P+D)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="SLO-driven decode autoscaling: scale up on "
+                         "burn rate / page pressure, rolling-drain down "
+                         "when quiet (needs --slo and a fleet)")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscaler floor for the scaled role "
+                         "(default 1; needs --autoscale)")
+    ap.add_argument("--max-replicas", type=int, default=None,
+                    help="autoscaler ceiling for the scaled role "
+                         "(default: the starting count; needs "
+                         "--autoscale)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel mesh size: shard params + the "
                          "KV pool on the head axis over N devices and "
@@ -494,18 +585,59 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"n_head={cfg.n_head} (the serving mesh shards whole "
               f"heads)", file=sys.stderr)
         return 2
-    if args.tp > 1 and args.replicas > 1:
-        print(f"apex-tpu-serve: --tp shards ONE engine over a mesh; "
-              f"--replicas {args.replicas} runs independent engines — a "
-              f"fleet of meshes is out of scope (pick one)",
-              file=sys.stderr)
-        return 2
     if args.tp_sync != "exact" and args.tp == 1:
         print(f"apex-tpu-serve: --tp-sync {args.tp_sync} relaxes "
               f"cross-rank synchronization; it needs --tp >= 2 (a "
               f"single chip has no collectives to overlap or relax)",
               file=sys.stderr)
         return 2
+
+    # disaggregation / autoscaler flag matrix, BEFORE any params or
+    # compile work (PR-10 precedent: inert or contradictory combinations
+    # are loud usage errors in milliseconds, never silent no-ops)
+    roles = _parse_roles(args.roles)
+    if args.roles is not None:
+        if roles is None:
+            print(f"apex-tpu-serve: --roles {args.roles!r}: want P:D "
+                  f"positive integers (P prefill replicas, D decode "
+                  f"replicas, e.g. 1:2)", file=sys.stderr)
+            return 2
+        if args.replicas is not None and args.replicas != sum(roles):
+            print(f"apex-tpu-serve: --roles {args.roles} is a "
+                  f"{sum(roles)}-replica fleet; --replicas "
+                  f"{args.replicas} contradicts it (drop one)",
+                  file=sys.stderr)
+            return 2
+        if not args.page_size or not args.prefix_cache:
+            print("apex-tpu-serve: --roles streams prompt pages "
+                  "through the prefix index; it needs --page-size and "
+                  "--prefix-cache", file=sys.stderr)
+            return 2
+        args.replicas = sum(roles)
+    elif args.replicas is None:
+        args.replicas = 1
+    if (args.min_replicas is not None or args.max_replicas is not None) \
+            and not args.autoscale:
+        print("apex-tpu-serve: --min-replicas/--max-replicas bound the "
+              "autoscaler; they need --autoscale", file=sys.stderr)
+        return 2
+    if args.autoscale:
+        if args.replicas < 2:
+            print("apex-tpu-serve: --autoscale scales a FLEET; it needs "
+                  "--replicas >= 2 (or --roles)", file=sys.stderr)
+            return 2
+        if not args.slo:
+            print("apex-tpu-serve: --autoscale scales on SLO burn rate; "
+                  "give it at least one --slo NAME=VALUE objective",
+                  file=sys.stderr)
+            return 2
+        mn = 1 if args.min_replicas is None else args.min_replicas
+        decode_n = roles[1] if roles else args.replicas
+        mx = decode_n if args.max_replicas is None else args.max_replicas
+        if not 1 <= mn <= mx:
+            print(f"apex-tpu-serve: need 1 <= --min-replicas <= "
+                  f"--max-replicas, got {mn} / {mx}", file=sys.stderr)
+            return 2
 
     # fleet flag matrix, BEFORE any params/compile work: an inert or
     # contradictory combination is a usage error that must fail in

@@ -719,6 +719,90 @@ class Engine:
             for slot in np.flatnonzero(mask):
                 self._release_slot_pages(int(slot))
 
+    # --------------------- page migration (disaggregated prefill→decode)
+    def export_prefix_pages(self, tokens: Sequence[int]):
+        """Snapshot the indexed prefix pages of ``tokens`` for streaming
+        into another replica's pool: ``[{chain_hash, k, v, digest}, ...]``
+        in chain order, one entry per consecutive indexed full chunk.
+        Payload arrays are host copies ``[n_layer, page_size, heads,
+        head_dim]`` (under tensor parallelism ``device_get`` gathers the
+        head shards — page indices are rank-invariant, payloads are
+        whole pages). The digest is stamped here, over the exact bytes
+        exported (:func:`~apex_tpu.serve.paging.page_payload_digest`), so
+        the receiver can certify the transfer. ``touch=False``: an
+        export is a read, not a use — it must not reorder the donor's
+        LRU. Empty when not paged / no prefix index / no indexed prefix.
+        """
+        if not self._paged or self.prefix is None:
+            return []
+        out = []
+        for h, page in self.prefix.lookup(tokens, touch=False):
+            k_np = np.asarray(jax.device_get(self.cache.k[:, page]))
+            v_np = np.asarray(jax.device_get(self.cache.v[:, page]))
+            out.append({
+                "chain_hash": h, "k": k_np, "v": v_np,
+                "digest": paging.page_payload_digest(
+                    h, k_np.tobytes(), v_np.tobytes()),
+            })
+        return out
+
+    def import_prefix_pages(self, payloads) -> Dict[str, int]:
+        """Install **certified** migrated pages into this engine's pool
+        and prefix index; returns ``{"installed", "duplicate",
+        "no_capacity"}`` counts. Certification (chain-hash + payload
+        digest) is the CALLER's job — the disaggregation controller
+        refuses un-certified pages before they reach here; this method
+        enforces only the structural contract (paged + prefix engine,
+        exact payload shape).
+
+        Exactly-once by construction: a payload whose chain hash is
+        already indexed is a duplicate stream (failover replay, a second
+        handoff of the same prefix) and is skipped — the index insert
+        no-op is the same door that makes two requests sharing a prompt
+        idempotent. Installed pages are index-only (refcount 1): they
+        age out through normal LRU eviction like locally-prefilled
+        prefix pages, and the next admission of the migrated prompt
+        shares them read-only exactly as a local prefix hit.
+        """
+        if not self._paged or self.prefix is None:
+            raise ValueError(
+                "import_prefix_pages needs a paged engine with "
+                "prefix_cache=True (page migration lands in the prefix "
+                "index)")
+        ps = int(self.config.page_size)
+        h_heads = self.model_cfg.n_head
+        d = self.model_cfg.n_embd // h_heads
+        shape = (self.model_cfg.n_layer, ps, h_heads, d)
+        stats = {"installed": 0, "duplicate": 0, "no_capacity": 0}
+        for p in payloads:
+            if tuple(np.shape(p["k"])) != shape or \
+                    tuple(np.shape(p["v"])) != shape:
+                raise ValueError(
+                    f"migrated page payload shape {np.shape(p['k'])} != "
+                    f"engine page shape {shape} (torn transfer should "
+                    f"have been refused at certification)")
+            if p["chain_hash"] in self.prefix:
+                stats["duplicate"] += 1
+                continue
+            if self.pool.free_count < 1:
+                self.prefix.evict(self.pool, 1)
+            if self.pool.free_count < 1:
+                # chain order: a missing page truncates the usable
+                # prefix, so later pages would be unreachable anyway
+                stats["no_capacity"] += len(payloads) - (
+                    stats["installed"] + stats["duplicate"])
+                break
+            page = self.pool.alloc(1)[0]
+            self.cache = kv_cache.install_page(
+                self.cache, page, jnp.asarray(p["k"]),
+                jnp.asarray(p["v"]))
+            self.prefix.insert(p["chain_hash"], page, self.pool)
+            # index-only residency (refcount 1): admission shares it
+            # read-only like any local prefix hit; LRU can reclaim it
+            self.pool.release(page)
+            stats["installed"] += 1
+        return stats
+
     @property
     def lengths(self) -> np.ndarray:
         return np.asarray(self.cache.lengths)
@@ -770,11 +854,18 @@ class Engine:
 
     @property
     def free_page_frac(self) -> float:
-        """Fraction of the allocatable pool currently free (1.0 for slot
-        engines — they have no pool to pressure)."""
+        """Fraction of the pool allocatable RIGHT NOW: free pages plus
+        index-only cached pages an LRU sweep could evict on demand (1.0
+        for slot engines — they have no pool to pressure). Counting
+        evictable pages matters: a warm prefix cache deliberately keeps
+        the free list near empty, so raw free_count reads as permanent
+        pressure on an engine that actually has plenty of headroom."""
         if not self._paged:
             return 1.0
-        return self.pool.free_count / max(self.pool.capacity, 1)
+        free = self.pool.free_count
+        if self.prefix is not None:
+            free += self.prefix.evictable(self.pool)
+        return free / max(self.pool.capacity, 1)
 
     @property
     def kv_cache_bytes(self) -> int:

@@ -276,14 +276,31 @@ class EngineReplica:
     reads — the snapshot idiom, no read-modify-write); everything else
     the worker touches is behind the scheduler or registry lock."""
 
+    ROLES = ("unified", "prefill", "decode")
+
     def __init__(self, replica_id: str, engine, *, admission=None,
-                 metrics=None, tracer=None, idle_sleep_s: float = 0.002):
+                 metrics=None, tracer=None, idle_sleep_s: float = 0.002,
+                 role: str = "unified"):
         self.replica_id = str(replica_id)
         self.engine = engine
         self.metrics = metrics
         self.scheduler = ServeScheduler(engine, admission=admission,
                                         metrics=metrics, tracer=tracer)
         self.idle_sleep_s = float(idle_sleep_s)
+        if role not in self.ROLES:
+            raise ValueError(
+                f"role={role!r} must be one of {self.ROLES}")
+        # disaggregated serving role (docs/serving.md "Disaggregated
+        # prefill/decode"): "prefill" replicas run prompt prefill and
+        # stream committed KV pages out, "decode" replicas receive pages
+        # and serve the client stream, "unified" does both (the
+        # non-disaggregated default — FleetController ignores roles)
+        self.role = role
+        # committed-but-undelivered page handoffs sourced at this
+        # replica — control-thread-only bookkeeping (the disaggregation
+        # controller is single-threaded by the FleetController contract);
+        # a draining prefill replica may not report drained while > 0
+        self.pending_handoffs = 0
         self.index = 0              # assigned by the controller (tiebreak)
         self.done_seen = 0          # harvest cursor into scheduler.done
         self.tick = 0
@@ -605,13 +622,19 @@ class FleetController:
                 "root": root,
                 "fleet_queue": self.tracer.begin("fleet_queue",
                                                  parent=root, t0=now)}
+        self._dispatch_new(freq, now)
+        return True
+
+    def _dispatch_new(self, freq: _FleetRequest, now: float) -> None:
+        """First dispatch of a fresh request: route or pend. The
+        disaggregation controller overrides this seam to interpose a
+        prefill→decode page handoff before the real dispatch."""
         handle = self._route()
         if handle is None:
             freq.next_dispatch_t = now
             self._pending.append(freq)
         else:
             self._submit_attempt(freq, handle, now)
-        return True
 
     def begin_drain(self) -> None:
         """Fleet-wide drain (the ``--drain-on SIGTERM`` contract): stop
@@ -1089,9 +1112,13 @@ class FleetController:
     def _maybe_mark_drained(self, handle: EngineReplica) -> None:
         """Draining → drained the moment the replica is idle (exactly
         one ``serve_replica_drained`` per drain — the state transition
-        is the guard)."""
+        is the guard). A draining PREFILL replica must first flush its
+        committed-but-undelivered page handoffs (``pending_handoffs``)
+        — declaring it drained with pages in flight would strand KV
+        state its decode targets are counting on; the disaggregation
+        controller's pump delivers them and drops the count to zero."""
         if self.registry.state(handle.replica_id) == REPLICA_DRAINING \
-                and handle.load() == 0:
+                and handle.load() == 0 and handle.pending_handoffs == 0:
             self.registry.set_state(handle.replica_id, REPLICA_DRAINED)
             publish_event(
                 "serve_replica_drained", replica=handle.replica_id,
@@ -1124,6 +1151,27 @@ class FleetController:
         self.replica_restarts += 1
         publish_event("serve_replica_restarted",
                       replica=handle.replica_id)
+
+    def add_replica(self, handle: EngineReplica) -> None:
+        """Admit a freshly-built replica into a running fleet (the
+        autoscaler's cold-spawn path — warm restarts of a DRAINED
+        standby go through :meth:`restart_replica` instead and cost
+        zero recompiles). The handle registers healthy with a fresh
+        heartbeat stamp and, if the fleet is started, its worker starts
+        immediately; ``serve_replica_spawned`` records the spawn."""
+        rid = handle.replica_id
+        if rid in self._by_id:
+            raise ValueError(
+                f"replica id {rid!r} already in the fleet (spawn needs "
+                f"a unique id; restart the existing one instead)")
+        handle.index = len(self.handles)
+        self.handles.append(handle)
+        self._by_id[rid] = handle
+        self.registry.register(rid)
+        if self._started:
+            handle.start(self.registry, self.injector)
+        publish_event("serve_replica_spawned", replica=rid,
+                      role=handle.role, replicas=len(self.handles))
 
     def rolling_restart(self, *, max_wall_s: float = 30.0
                         ) -> Dict[str, int]:

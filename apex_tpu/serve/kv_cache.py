@@ -290,3 +290,23 @@ def copy_page(cache: PagedKVCache, src, dst) -> PagedKVCache:
     return cache.replace(
         k=cache.k.at[:, dst].set(cache.k[:, src]),
         v=cache.v.at[:, dst].set(cache.v[:, src]))
+
+
+# host-callable page install: ONE jitted op (the page index is a traced
+# scalar, the payload a fixed-shape array), compiled once per engine —
+# landing a migrated page from another replica's pool costs one scatter,
+# never a recompile. The inverse of reading `cache.k[:, page]` out: the
+# disaggregated prefill→decode handoff streams `[n_layer, page_size,
+# heads, head_dim]` payloads and this op parks them under a pool index
+# the receiving allocator chose.
+@jax.jit
+def install_page(cache: PagedKVCache, page, k_page: jax.Array,
+                 v_page: jax.Array) -> PagedKVCache:
+    """Write a whole page's K/V payload into pool slot ``page`` across
+    every layer. ``k_page``/``v_page``: ``[n_layer, page_size, heads,
+    head_dim]``. The caller owns ``page`` (freshly allocated, refcount
+    held), so the scatter can never alias a live slot's append."""
+    page = jnp.asarray(page, jnp.int32)
+    return cache.replace(
+        k=cache.k.at[:, page].set(k_page.astype(cache.k.dtype)),
+        v=cache.v.at[:, page].set(v_page.astype(cache.v.dtype)))

@@ -66,6 +66,23 @@ def chunk_hashes(tokens: Sequence[int], page_size: int) -> List[str]:
     return out
 
 
+def page_payload_digest(chain_hash: str, k_bytes: bytes,
+                        v_bytes: bytes) -> str:
+    """Transport digest for one migrated KV page: blake2b over the chain
+    hash it claims plus the raw K/V bytes. The sender stamps it at
+    export; the receiver recomputes it over what actually arrived, so a
+    bit flip or torn copy in flight fails certification even though the
+    *claimed* chain hash still matches the receiver's expectation. Two
+    independent checks, two failure classes: the chain hash certifies
+    "these are the pages for THIS prompt prefix", the payload digest
+    certifies "these bytes are the ones the prefill replica committed"."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(bytes.fromhex(chain_hash))
+    h.update(k_bytes)
+    h.update(v_bytes)
+    return h.hexdigest()
+
+
 class PagePool:
     """Free-list page allocator with refcounts over ``num_pages`` device
     pages (page 0 reserved as the null page)."""

@@ -646,6 +646,24 @@ class ServeScheduler:
                 self._close_trace(req, "evict", "migrated")
             return req
 
+    def export_prefix_pages(self, tokens):
+        """Thread-safe export of the engine's indexed prefix pages for
+        ``tokens`` (:meth:`Engine.export_prefix_pages`) — the
+        disaggregation controller calls this on a PREFILL replica from
+        the control thread while the replica's worker may be mid-tick,
+        so the read takes the scheduler lock the tick holds."""
+        with self._lock:
+            return self.engine.export_prefix_pages(tokens)
+
+    def import_prefix_pages(self, payloads):
+        """Thread-safe install of certified migrated pages into the
+        engine's pool (:meth:`Engine.import_prefix_pages`) — the
+        disaggregation controller calls this on a DECODE replica from
+        the control thread; the lock serializes the pool/index/cache
+        mutation against the worker's own admissions."""
+        with self._lock:
+            return self.engine.import_prefix_pages(payloads)
+
     def _remove_queued(self, request_id) -> Optional[Request]:
         """Take a request out of the queue and publish its uncharged
         wait — the ONE queue-exit bookkeeping (abort and pop_queued

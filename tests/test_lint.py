@@ -636,6 +636,55 @@ def test_apx002_covers_fleet_registry_heartbeat_thread(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx002_covers_autoscaler_handoff_tables(tmp_path):
+    """PR-16 coverage proof: the disaggregation controller's handoff
+    table and the autoscaler's action state are control-thread-only BY
+    DESIGN — they own no lock, so APX002 has nothing to say about the
+    real module. But the tempting 'optimization' of letting each
+    replica's worker thread commit its own handoffs needs a lock the
+    moment it appears: a locked table mutated lock-free from the worker
+    callback fires; the lock-disciplined spelling stays quiet."""
+    _fixture(tmp_path, "apex_tpu/serve/disagg.py", """\
+        import threading
+
+        class HandoffTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._handoffs = {}
+
+            def begin(self, rid, ho):
+                with self._lock:
+                    self._handoffs[rid] = ho
+
+            def on_clone_done(self, rid):
+                # worker-thread callback — lock-free commit
+                self._handoffs[rid] = "committed"
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 1
+    assert "lock-free" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "serve" / "disagg.py"
+    good.write_text(textwrap.dedent("""\
+        import threading
+
+        class HandoffTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._handoffs = {}
+
+            def begin(self, rid, ho):
+                with self._lock:
+                    self._handoffs[rid] = ho
+
+            def on_clone_done(self, rid):
+                with self._lock:
+                    self._handoffs[rid] = "committed"
+        """))
+    active, _ = _run(tmp_path, "APX002")
+    assert not active, [v.format() for v in active]
+
+
 def test_apx005_covers_train_preempt_drain_stamp(tmp_path):
     """PR-14 coverage proof: a trainer preemption drain whose
     ``train_preempt_drain`` seconds are computed from ``time.time()``

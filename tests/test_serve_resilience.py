@@ -271,13 +271,17 @@ def test_pool_low_watermark_counts_as_overload(paged2):
     assert not adm.overloaded(queue_depth=0)
 
     # end-to-end: a drained overcommitted pool degrades admitted budgets.
+    # free_page_frac counts free + LRU-evictable index pages (a completed
+    # request's cached pages are allocatable on demand — PR 16), so the
+    # watermark sits above the in-use-dominated fraction r1 pins (3/8
+    # pages held while it decodes), not the raw free-list level.
     # r0 finishes fast and frees its slot while long-running r1 keeps
     # holding pages, so r2 is admitted INTO the drained-pool window and
     # gets the clamp
     eng = paged2.reset()
     sysp = _tokens(8, seed=99)
     adm = AdmissionController(degraded_max_new_tokens=3, sustain_ticks=1,
-                              pool_frac_low=0.60)
+                              pool_frac_low=0.70)
     sched = ServeScheduler(eng, admission=adm)
     seen = []
     unsub = subscribe_events(

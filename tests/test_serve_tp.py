@@ -402,9 +402,10 @@ def test_serve_cli_tp_exit2_matrix(capsys):
     errors BEFORE any params/compile work."""
     from apex_tpu.serve.cli import main
 
+    # --tp 2 --replicas 2 is no longer here: PR 16 made it the
+    # fleet-of-meshes configuration (see test_serve_disagg)
     for argv in (["--tp", "3"],                       # 3 ∤ n_head=4
                  ["--tp", "0"],
-                 ["--tp", "2", "--replicas", "2"],    # fleet-of-meshes
                  ["--tp-sync", "relaxed"],            # sync without mesh
                  ["--tp-sync", "overlap"]):
         assert main(argv) == 2, argv

@@ -94,7 +94,13 @@ _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 # win ("restarts" deliberately plural: the fleet's
                 # "replica_restarted" counter keeps its own direction)
                 "restarts", "preempt_drains", "steps_retried",
-                "recompile")
+                "recompile",
+                # disaggregated serving (PR 16): refused handoffs are
+                # certification failures (corrupt/torn page streams) and
+                # autoscale up/down counts are control-loop churn — a
+                # 0 -> N refusal storm or a flapping autoscaler gates
+                # off a zero baseline, never reads as neutral
+                "handoff_refused", "autoscale")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated
@@ -367,7 +373,13 @@ def check_device_kinds(current_path: str, baseline_path: str,
 # exact-mode capture as a clean win. The dict value is the default for
 # captures that predate the axis (old baselines carry no "tp" key and
 # are single-chip by construction; tp_sync is stamped None off-mesh).
-INCOMPARABLE_WORKLOAD_KEYS = {"tp": 1, "tp_sync": None}
+# Disaggregation is a third such axis: a disaggregated capture spends
+# decode-replica capacity on migrated pages and routes prefill work to
+# dedicated replicas — its latency/throughput must never gate against a
+# unified capture (roles None = unified; old captures predate the axis).
+INCOMPARABLE_WORKLOAD_KEYS = {"tp": 1, "tp_sync": None,
+                              "disagg": False, "roles": None,
+                              "diurnal": False}
 
 
 def incomparable_entries(cur_doc: dict, base_doc: dict) -> Dict[str, str]:
