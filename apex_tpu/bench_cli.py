@@ -377,7 +377,9 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  tp_sync: str = "exact",
                  disagg: bool = False,
                  roles: "str | None" = None,
-                 diurnal: bool = False) -> None:
+                 diurnal: bool = False,
+                 cost_ledger: "str | None" = None,
+                 chip_spec: "str | None" = None) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -413,6 +415,14 @@ def _serve_bench(steps: int, num_slots: int = 4,
     ``--tp-sync`` picks the per-layer collective mode (exact = the
     bit-identical oracle; overlap/relaxed trade exactness for less or
     hidden collective pressure).
+
+    ``--cost-ledger PATH`` additionally commits the device-independent
+    compiled-step cost ledger (``apex_tpu.cost_ledger/v1``: per-phase
+    FLOPs/HBM bytes extracted from the SAME AOT artifacts the bench
+    ran, roofline-priced per ``--chip-spec``) — the wall-clock-free
+    regression artifact ``check_regression`` gates and
+    ``tools/cost_diff.py`` attributes. See docs/performance.md "Cost
+    ledgers and roofline gating".
     """
     import dataclasses
     import json
@@ -448,6 +458,29 @@ def _serve_bench(steps: int, num_slots: int = 4,
     if replicas < 1:
         raise SystemExit(f"apex-tpu-bench: --replicas {replicas} must "
                          f"be >= 1")
+    # cost-ledger matrix (same inert/contradictory-flag discipline):
+    # validated against the ledger module's own chip-spec table BEFORE
+    # any params/compile work
+    if chip_spec is not None or cost_ledger:
+        import os as _os
+
+        from apex_tpu.monitor import costs
+
+        if chip_spec is not None and not cost_ledger:
+            raise SystemExit(
+                "apex-tpu-bench: --chip-spec prices the cost ledger's "
+                "roofline; it needs --cost-ledger")
+        if chip_spec is not None and chip_spec not in costs.CHIP_SPECS:
+            raise SystemExit(
+                f"apex-tpu-bench: unknown --chip-spec {chip_spec!r}; "
+                f"known specs: {', '.join(sorted(costs.CHIP_SPECS))}")
+        if cost_ledger and metrics_snapshot and (
+                _os.path.abspath(cost_ledger)
+                == _os.path.abspath(metrics_snapshot)):
+            raise SystemExit(
+                f"apex-tpu-bench: --cost-ledger and --metrics-snapshot "
+                f"both write {cost_ledger!r} — the second atomic commit "
+                f"would clobber the first (pick two paths)")
     # disaggregation matrix (PR-10 precedent, same as apex-tpu-serve)
     role_split = None
     if roles is not None and not disagg:
@@ -912,6 +945,22 @@ def _serve_bench(steps: int, num_slots: int = 4,
             "complete": False,
         },
     }
+    if cost_ledger:
+        # device-independent companion artifact: the per-executable cost
+        # ledger extracted from the SAME AOT artifacts the bench just
+        # ran (no re-trace, no re-lower — Engine.cost_ledger resolves
+        # from the retained lowerings), provenance-stamped so
+        # check_regression can refuse cross-device/cross-workload gates
+        from apex_tpu.monitor import costs
+        from apex_tpu.monitor.export import atomic_write_json
+
+        ledger = engine.cost_ledger(chip=chip_spec)
+        ledger["meta"] = capture_provenance()
+        atomic_write_json(cost_ledger, ledger)
+        print(f"apex-tpu-bench: cost ledger (chip="
+              f"{ledger['chip_spec']}, gating={ledger['gating']}, "
+              f"schema={costs.LEDGER_SCHEMA}) at {cost_ledger}",
+              file=sys.stderr)
     if bench is not None:
         # same contract as the kernel-subset gate: atomic publish via the
         # repo bench module (loaded up front — a torn gate file is worse
@@ -985,7 +1034,9 @@ def main() -> None:
         has_serve = any(a == "--serve" for a in sys.argv[1:])
         serve_only = [a for a in sys.argv[1:]
                       if a.split("=", 1)[0] in ("--disagg", "--roles",
-                                                "--diurnal")]
+                                                "--diurnal",
+                                                "--cost-ledger",
+                                                "--chip-spec")]
         if serve_only and not has_serve:
             # without --serve these would silently fall through to the
             # kernel bench — the inert-flag class this matrix refuses
@@ -1161,6 +1212,18 @@ def main() -> None:
                                  "compressed diurnal day instead of an "
                                  "upfront burst (needs --replicas >= 2 "
                                  "or --disagg)")
+            ap.add_argument("--cost-ledger", default=None, metavar="PATH",
+                            help="write the device-independent compiled-"
+                                 "step cost ledger (per-phase FLOPs/HBM "
+                                 "bytes from the benched AOT artifacts, "
+                                 "apex_tpu.cost_ledger/v1) — gateable by "
+                                 "check_regression, diffable by "
+                                 "tools/cost_diff.py")
+            ap.add_argument("--chip-spec", default=None,
+                            help="price the ledger roofline against this "
+                                 "chip generation (e.g. v5p, v6e; "
+                                 "default: detected chip, else the non-"
+                                 "gating cpu spec; needs --cost-ledger)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -1184,7 +1247,9 @@ def main() -> None:
                          flight_recorder=args.flight_recorder,
                          tp=args.tp, tp_sync=args.tp_sync,
                          disagg=args.disagg, roles=args.roles,
-                         diurnal=args.diurnal)
+                         diurnal=args.diurnal,
+                         cost_ledger=args.cost_ledger,
+                         chip_spec=args.chip_spec)
         elif has_telemetry:
             import argparse
 

@@ -189,37 +189,46 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
 
     x = (p["wte"][tokens].astype(dt)
          + p["wpe"][jnp.clip(pos, 0, c.n_positions - 1)].astype(dt))
+    # phase markers: trace-safe jax.named_scope only (scope names ride
+    # the MLIR loc(...) metadata — monitor/costs.py attributes the cost
+    # ledger per phase on them; no traced effect, APX001-quiet)
     for i in range(c.n_layer):
         blk = p[f"h_{i}"]
-        y = _affine_layer_norm(x, blk["ln_1"]["weight"],
-                               blk["ln_1"]["bias"])
-        qkv = (y.astype(dt) @ blk["attn_qkv"]["kernel"].astype(dt)
-               + blk["attn_qkv"]["bias"].astype(dt))
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(-1, h, d)
-        k = k.reshape(-1, h, d)
-        v = v.reshape(-1, h, d)
-        if paged:
-            cache = paged_write_token(cache, i, k, v, pos, write_mask)
-            o = paged_attention(q, cache.k[i], cache.v[i],
-                                cache.page_table, pos, block_k=block_k)
-        else:
-            cache = write_token(cache, i, k, v, pos, write_mask)
-            o = cached_attention(q, cache.k[i], cache.v[i], pos,
-                                 block_k=block_k)
-        o = o.reshape(-1, c.n_embd)
-        x = x + (o.astype(dt) @ blk["attn_out"]["kernel"].astype(dt)
-                 + blk["attn_out"]["bias"].astype(dt))
-        y = _affine_layer_norm(x, blk["ln_2"]["weight"],
-                               blk["ln_2"]["bias"])
-        x = x + dense_gelu_dense(y, blk["mlp_fc_w"].astype(dt),
-                                 blk["mlp_fc_b"].astype(dt),
-                                 blk["mlp_proj_w"].astype(dt),
-                                 blk["mlp_proj_b"].astype(dt))
-    x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
-    logits = jax.lax.dot_general(
-        x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        with jax.named_scope("ln_qkv"):
+            y = _affine_layer_norm(x, blk["ln_1"]["weight"],
+                                   blk["ln_1"]["bias"])
+            qkv = (y.astype(dt) @ blk["attn_qkv"]["kernel"].astype(dt)
+                   + blk["attn_qkv"]["bias"].astype(dt))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(-1, h, d)
+            k = k.reshape(-1, h, d)
+            v = v.reshape(-1, h, d)
+        with jax.named_scope("attention"):
+            if paged:
+                cache = paged_write_token(cache, i, k, v, pos,
+                                          write_mask)
+                o = paged_attention(q, cache.k[i], cache.v[i],
+                                    cache.page_table, pos,
+                                    block_k=block_k)
+            else:
+                cache = write_token(cache, i, k, v, pos, write_mask)
+                o = cached_attention(q, cache.k[i], cache.v[i], pos,
+                                     block_k=block_k)
+            o = o.reshape(-1, c.n_embd)
+            x = x + (o.astype(dt) @ blk["attn_out"]["kernel"].astype(dt)
+                     + blk["attn_out"]["bias"].astype(dt))
+        with jax.named_scope("mlp"):
+            y = _affine_layer_norm(x, blk["ln_2"]["weight"],
+                                   blk["ln_2"]["bias"])
+            x = x + dense_gelu_dense(y, blk["mlp_fc_w"].astype(dt),
+                                     blk["mlp_fc_b"].astype(dt),
+                                     blk["mlp_proj_w"].astype(dt),
+                                     blk["mlp_proj_b"].astype(dt))
+    with jax.named_scope("sampling"):
+        x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
+        logits = jax.lax.dot_general(
+            x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
     return logits, cache
 
 
@@ -234,10 +243,12 @@ def _psum_halves_into(part, resid, bias, axis_name, ln=None):
     Megatron row-parallel only in schedule, never in value. Returns
     ``(x, ln_x | None)``."""
     half = part.shape[0] // 2
-    r1 = jax.lax.psum(part[:half], axis_name)
+    with jax.named_scope("collective"):
+        r1 = jax.lax.psum(part[:half], axis_name)
     x1 = resid[:half] + r1 + bias
     y1 = ln(x1) if ln is not None else None
-    r2 = jax.lax.psum(part[half:], axis_name)
+    with jax.named_scope("collective"):
+        r2 = jax.lax.psum(part[half:], axis_name)
     x2 = resid[half:] + r2 + bias
     y2 = ln(x2) if ln is not None else None
     x = jnp.concatenate([x1, x2], axis=0)
@@ -290,43 +301,55 @@ def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
 
     x = (p["wte"][tokens].astype(dt)
          + p["wpe"][jnp.clip(pos, 0, c.n_positions - 1)].astype(dt))
+    # phase markers mirror gpt2_token_forward's; collective sites carry
+    # their own nested "collective" scope (innermost scope wins in the
+    # ledger walk, so a gather inside attention attributes to collective)
     for i in range(c.n_layer):
         blk = p[f"h_{i}"]
-        y = _affine_layer_norm(x, blk["ln_1"]["weight"],
-                               blk["ln_1"]["bias"])
-        # local heads' q/k/v: the permuted kernel slice is exactly this
-        # rank's columns of the full projection, so each output column's
-        # dot product is the single-chip one
-        qkv = (y.astype(dt) @ blk["attn_qkv"]["kernel"].astype(dt)
-               + blk["attn_qkv"]["bias"].astype(dt))
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(-1, h_loc, d)
-        k = k.reshape(-1, h_loc, d)
-        v = v.reshape(-1, h_loc, d)
-        if paged:
-            cache = paged_write_token(cache, i, k, v, pos, write_mask)
-            o = paged_attention(q, cache.k[i], cache.v[i],
-                                cache.page_table, pos, block_k=block_k)
-        else:
-            cache = write_token(cache, i, k, v, pos, write_mask)
-            o = cached_attention(q, cache.k[i], cache.v[i], pos,
-                                 block_k=block_k)
-        out_b = blk["attn_out"]["bias"].astype(dt)
-        if sync == "exact":
-            # concatenate the heads across ranks, then the FULL output
-            # projection replicated: no float add crosses a rank
-            o_full = jax.lax.all_gather(o, axis_name, axis=1, tiled=True)
-            o_full = o_full.reshape(-1, c.n_embd)
-            x = x + (o_full.astype(dt)
-                     @ blk["attn_out"]["kernel"].astype(dt) + out_b)
-            y = _affine_layer_norm(x, blk["ln_2"]["weight"],
-                                   blk["ln_2"]["bias"])
-        else:
-            # row-parallel output projection: this rank's heads hit its
-            # rows of the kernel — a PARTIAL [num_slots, e] sum
-            attn_part = (o.reshape(-1, h_loc * d).astype(dt)
-                         @ blk["attn_out"]["kernel"].astype(dt))
-            if sync == "overlap":
+        with jax.named_scope("ln_qkv"):
+            y = _affine_layer_norm(x, blk["ln_1"]["weight"],
+                                   blk["ln_1"]["bias"])
+            # local heads' q/k/v: the permuted kernel slice is exactly
+            # this rank's columns of the full projection, so each output
+            # column's dot product is the single-chip one
+            qkv = (y.astype(dt) @ blk["attn_qkv"]["kernel"].astype(dt)
+                   + blk["attn_qkv"]["bias"].astype(dt))
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(-1, h_loc, d)
+            k = k.reshape(-1, h_loc, d)
+            v = v.reshape(-1, h_loc, d)
+        with jax.named_scope("attention"):
+            if paged:
+                cache = paged_write_token(cache, i, k, v, pos,
+                                          write_mask)
+                o = paged_attention(q, cache.k[i], cache.v[i],
+                                    cache.page_table, pos,
+                                    block_k=block_k)
+            else:
+                cache = write_token(cache, i, k, v, pos, write_mask)
+                o = cached_attention(q, cache.k[i], cache.v[i], pos,
+                                     block_k=block_k)
+            out_b = blk["attn_out"]["bias"].astype(dt)
+            if sync == "exact":
+                # concatenate the heads across ranks, then the FULL
+                # output projection replicated: no float add crosses a
+                # rank
+                with jax.named_scope("collective"):
+                    o_full = jax.lax.all_gather(o, axis_name, axis=1,
+                                                tiled=True)
+                o_full = o_full.reshape(-1, c.n_embd)
+                x = x + (o_full.astype(dt)
+                         @ blk["attn_out"]["kernel"].astype(dt) + out_b)
+            else:
+                # row-parallel output projection: this rank's heads hit
+                # its rows of the kernel — a PARTIAL [num_slots, e] sum
+                attn_part = (o.reshape(-1, h_loc * d).astype(dt)
+                             @ blk["attn_out"]["kernel"].astype(dt))
+        with jax.named_scope("mlp"):
+            if sync == "exact":
+                y = _affine_layer_norm(x, blk["ln_2"]["weight"],
+                                       blk["ln_2"]["bias"])
+            elif sync == "overlap":
                 x, y = _psum_halves_into(
                     attn_part, x, out_b, axis_name,
                     ln=lambda v_: _affine_layer_norm(
@@ -335,37 +358,44 @@ def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
                 y = _affine_layer_norm(x + attn_part + out_b,
                                        blk["ln_2"]["weight"],
                                        blk["ln_2"]["bias"])
-        # MLP, column-parallel fc (this rank's 4e/tp rows), mirroring
-        # transformer.fused_dense.dense_gelu_dense's primal ops exactly
-        h = jax.lax.dot_general(
-            y.astype(dt), blk["mlp_fc_w"].astype(dt),
-            (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-        h = h + blk["mlp_fc_b"].astype(jnp.float32)
-        a = jax.nn.gelu(h, approximate=False)
-        proj_b = blk["mlp_proj_b"].astype(jnp.float32).astype(dt)
-        if sync == "exact":
-            a_full = jax.lax.all_gather(a.astype(dt), axis_name, axis=1,
-                                        tiled=True)
-            m = jax.lax.dot_general(
-                a_full, blk["mlp_proj_w"].astype(dt),
+            # MLP, column-parallel fc (this rank's 4e/tp rows),
+            # mirroring fused_dense.dense_gelu_dense's primal ops exactly
+            h = jax.lax.dot_general(
+                y.astype(dt), blk["mlp_fc_w"].astype(dt),
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
-            x = x + (m + blk["mlp_proj_b"].astype(jnp.float32)).astype(dt)
-        else:
-            mlp_part = jax.lax.dot_general(
-                a.astype(dt), blk["mlp_proj_w"].astype(dt),
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32).astype(dt)
-            if sync == "overlap":
-                x, _ = _psum_halves_into(mlp_part, x, proj_b, axis_name)
+            h = h + blk["mlp_fc_b"].astype(jnp.float32)
+            a = jax.nn.gelu(h, approximate=False)
+            proj_b = blk["mlp_proj_b"].astype(jnp.float32).astype(dt)
+            if sync == "exact":
+                with jax.named_scope("collective"):
+                    a_full = jax.lax.all_gather(a.astype(dt), axis_name,
+                                                axis=1, tiled=True)
+                m = jax.lax.dot_general(
+                    a_full, blk["mlp_proj_w"].astype(dt),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                x = x + (m
+                         + blk["mlp_proj_b"].astype(jnp.float32)
+                         ).astype(dt)
             else:
-                # relaxed: ONE all-reduce lands the deferred attention
-                # partial and the MLP partial together; the residual
-                # stream is fully synchronized again at layer exit
-                x, _ = _psum_halves_into(attn_part + mlp_part, x,
-                                         out_b + proj_b, axis_name)
-    x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
-    logits = jax.lax.dot_general(
-        x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+                mlp_part = jax.lax.dot_general(
+                    a.astype(dt), blk["mlp_proj_w"].astype(dt),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32).astype(dt)
+                if sync == "overlap":
+                    x, _ = _psum_halves_into(mlp_part, x, proj_b,
+                                             axis_name)
+                else:
+                    # relaxed: ONE all-reduce lands the deferred
+                    # attention partial and the MLP partial together;
+                    # the residual stream is fully synchronized again at
+                    # layer exit
+                    x, _ = _psum_halves_into(attn_part + mlp_part, x,
+                                             out_b + proj_b, axis_name)
+    with jax.named_scope("sampling"):
+        x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
+        logits = jax.lax.dot_general(
+            x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
     return logits, cache

@@ -37,6 +37,7 @@ snapshot) into a CI gate against a committed bench baseline. See
 docs/observability.md.
 """
 
+from apex_tpu.monitor import costs  # noqa: F401
 from apex_tpu.monitor.export import (  # noqa: F401
     MetricsExporter, MetricsRegistry, histogram_quantile, merge_snapshots,
     percentile, snapshot_to_prometheus, write_snapshot)

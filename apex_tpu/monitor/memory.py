@@ -36,11 +36,10 @@ _SAMPLED_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
                  "largest_alloc_size", "bytes_reserved",
                  "largest_free_block_bytes", "pool_bytes")
 
-# the device-side fields of CompiledMemoryStats (host_* mirrors skipped:
-# they are zero everywhere we run and double the record size)
-_STATIC_KEYS = ("argument_size_in_bytes", "output_size_in_bytes",
-                "temp_size_in_bytes", "alias_size_in_bytes",
-                "generated_code_size_in_bytes")
+# the device-side fields of CompiledMemoryStats — moved to
+# monitor/costs.py (the cost ledger reads the same record); re-exported
+# here for compatibility
+from apex_tpu.monitor.costs import MEMORY_STATIC_KEYS as _STATIC_KEYS
 
 
 def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
@@ -70,26 +69,12 @@ def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
 def memory_analysis_record(compiled) -> Optional[Dict[str, int]]:
     """``compiled.memory_analysis()`` as a plain int dict (plus the
     derived ``reserved_bytes`` total), or ``None`` when the executable
-    doesn't expose one."""
-    try:
-        ma = compiled.memory_analysis()
-    except Exception:
-        return None
-    if isinstance(ma, (list, tuple)):
-        ma = ma[0] if ma else None
-    if ma is None:
-        return None
-    out: Dict[str, int] = {}
-    for k in _STATIC_KEYS:
-        v = getattr(ma, k, None)
-        if isinstance(v, (int, float)):
-            out[k] = int(v)
-    if not out:
-        return None
-    out["reserved_bytes"] = (out.get("argument_size_in_bytes", 0)
-                             + out.get("output_size_in_bytes", 0)
-                             + out.get("temp_size_in_bytes", 0))
-    return out
+    doesn't expose one. Delegates to ``monitor/costs.py`` — the cost
+    ledger's ``xla.memory_analysis`` entry and the ``hbm_snapshot``
+    events extract through ONE spelling."""
+    from apex_tpu.monitor import costs
+
+    return costs.memory_analysis_record(compiled)
 
 
 def publish_compiled_memory(name: str, compiled,

@@ -109,13 +109,10 @@ def step_flops(fn, *args, compiled=None) -> float:
     derives FLOPs AND the static memory analysis from one compile.
     Returns 0.0 when the backend reports no cost analysis
     (interpret-mode CPU paths)."""
+    from apex_tpu.monitor import costs
+
     if compiled is None:
         compiled = compile_for_analysis(fn, *args)
-    if compiled is None:
-        return 0.0
-    try:
-        ca = compiled.cost_analysis()
-    except Exception:
-        return 0.0
-    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
-    return float(ca.get("flops", 0.0))
+    # ONE spelling of the cost_analysis() extraction dance, shared with
+    # the ledger and utils/prof.py (monitor/costs.py owns it)
+    return costs.xla_flops(compiled)

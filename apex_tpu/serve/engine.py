@@ -234,6 +234,10 @@ class Engine:
         #                                postmortems never re-trace
         self._prefill_jits: Dict[int, Any] = {}
         self._prefill_aot: Dict[int, Any] = {}
+        self._prefill_lowered: Dict[int, Any] = {}   # same retention
+        #                                contract as _decode_lowered: the
+        #                                cost ledger reads prefill costs
+        #                                without re-lowering after reset()
         if self._tp > 1:
             publish_event(
                 "serve_tp_mesh_ready", tp=self._tp,
@@ -285,8 +289,9 @@ class Engine:
         positions = cache.lengths
         logits, cache = self._token_step(cache, last_tokens, positions,
                                          active)
-        rng, sub = jax.random.split(rng)
-        next_tokens = self._sample(logits, sub)
+        with jax.named_scope("sampling"):
+            rng, sub = jax.random.split(rng)
+            next_tokens = self._sample(logits, sub)
         cache = kv_cache.advance(cache, active)
         return next_tokens, logits, cache, rng
 
@@ -318,8 +323,9 @@ class Engine:
                 body, (cache, init_logits),
                 jnp.arange(bucket, dtype=jnp.int32))
             cache = kv_cache.set_lengths(cache, admit, start + tail_lens)
-            rng, sub = jax.random.split(rng)
-            first_tokens = self._sample(last_logits, sub)
+            with jax.named_scope("sampling"):
+                rng, sub = jax.random.split(rng)
+                first_tokens = self._sample(last_logits, sub)
             return cache, first_tokens, last_logits, all_logits, rng
 
         return jax.jit(prefill_fn)
@@ -363,8 +369,12 @@ class Engine:
             if bucket not in self._prefill_aot:
                 fn = self._prefill_jits.setdefault(
                     bucket, self._make_prefill(bucket))
-                self._prefill_aot[bucket] = fn.lower(
-                    *self._prefill_args(bucket)).compile()
+                # retained like _decode_lowered: cost_ledger() prices
+                # prefill buckets from the saved lowering — after a
+                # reset()/warm restart there is nothing to re-trace
+                lowered = fn.lower(*self._prefill_args(bucket))
+                self._prefill_lowered[bucket] = lowered
+                self._prefill_aot[bucket] = lowered.compile()
                 publish_compiled_memory(
                     "serve_prefill", self._prefill_aot[bucket],
                     bucket=bucket, num_slots=self.config.num_slots,
@@ -837,6 +847,53 @@ class Engine:
         if self._decode_lowered is None:
             self.aot_compile()
         return serve_tp.count_collectives(self._decode_lowered.as_text())
+
+    def cost_ledger(self, chip: Optional[str] = None,
+                    prompt_buckets: Sequence[int] = ()) -> Dict[str, Any]:
+        """The engine's compiled-step cost ledger
+        (``apex_tpu.monitor.costs``): phase-attributed FLOPs/HBM bytes/
+        op histograms walked from the SAVED AOT lowerings plus XLA's own
+        cost/memory analyses, with a roofline projection on ``chip``
+        (auto-detected; ``"cpu"`` — marked non-gating — off silicon).
+
+        Rides ``_decode_lowered``/``_prefill_lowered`` exactly like
+        :meth:`decode_collectives` — producing them first if needed,
+        never re-tracing (``decode_traces`` stays at 1), and surviving
+        ``reset()``/warm restarts, which keep the compiled artifacts.
+        Entries: ``decode`` plus ``prefill_<bucket>`` for every bucket
+        already compiled or requested via ``prompt_buckets``.
+        """
+        from apex_tpu.monitor import costs
+        from apex_tpu.utils.prof import detect_chip
+
+        if self._decode_lowered is None or any(
+                pow2_ceil(int(b)) not in self._prefill_lowered
+                for b in prompt_buckets):
+            self.aot_compile(prompt_buckets)
+        execs = {"decode": costs.executable_record(
+            self._decode_lowered, self._decode_aot)}
+        for bucket in sorted(self._prefill_lowered):
+            execs[f"prefill_{bucket}"] = costs.executable_record(
+                self._prefill_lowered[bucket],
+                self._prefill_aot.get(bucket))
+        dtype = jnp.dtype(self.model_cfg.compute_dtype)
+        workload = {
+            "model": "gpt2",
+            "num_slots": int(self.config.num_slots),
+            "max_len": int(self.max_len),
+            "page_size": int(self.config.page_size or 0),
+            "dtype": dtype.name,
+            "dtype_bytes": int(dtype.itemsize),
+            "block_k": int(self.block_k),
+            "tp": int(self._tp),
+            "tp_sync": self.config.tp_sync if self._tp > 1 else None,
+            "n_layer": int(self.model_cfg.n_layer),
+            "n_embd": int(self.model_cfg.n_embd),
+            "n_head": int(self.model_cfg.n_head),
+            "vocab_size": int(self.model_cfg.vocab_size),
+        }
+        return costs.build_ledger(execs, workload,
+                                  chip=chip or detect_chip() or "cpu")
 
     def tp_rank_snapshots(self, meta: Optional[Dict[str, Any]] = None):
         """Per-rank mergeable metrics snapshots (the PR-10

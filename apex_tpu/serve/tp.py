@@ -198,28 +198,25 @@ def expected_collectives(n_layer: int, sync: str) -> Dict[str, int]:
       slot-half psums (TokenWeave) = 4 all-reduces, zero gathers.
     - ``relaxed``: ONE deferred all-reduce per layer (attention partial +
       MLP partial land together), split in two halves = 2 all-reduces.
+
+    Delegates to ``monitor/costs.py:expected_collective_ops`` — the cost
+    ledger prices collective bytes from the SAME contract, so the two
+    spellings can never diverge.
     """
-    if sync == "exact":
-        return {"all_gather": 2 * n_layer, "all_reduce": 0}
-    if sync == "overlap":
-        return {"all_gather": 0, "all_reduce": 4 * n_layer}
-    if sync == "relaxed":
-        return {"all_gather": 0, "all_reduce": 2 * n_layer}
-    raise ValueError(f"unknown tp_sync mode {sync!r}; "
-                     f"pick one of {SYNC_MODES}")
+    from apex_tpu.monitor import costs
+
+    return costs.expected_collective_ops(n_layer, sync)
 
 
 def count_collectives(stablehlo_text: str) -> Dict[str, int]:
     """Count collective ops in a lowered module's StableHLO text — the
     verifier side of :func:`expected_collectives` (pre-XLA-pass text, so
     only the shard_map-explicit collectives count, never a compiler
-    resharding)."""
-    return {
-        "all_gather": stablehlo_text.count("stablehlo.all_gather"),
-        "all_reduce": stablehlo_text.count("stablehlo.all_reduce"),
-        "all_to_all": stablehlo_text.count("stablehlo.all_to_all"),
-        "permute": stablehlo_text.count("collective_permute"),
-    }
+    resharding). Delegates to ``monitor/costs.py:collective_counts``
+    (the generalized ledger walk owns the spelling)."""
+    from apex_tpu.monitor import costs
+
+    return costs.collective_counts(stablehlo_text)
 
 
 def rank_snapshots(engine, meta: Optional[Dict[str, Any]] = None

@@ -137,11 +137,37 @@ class StepTimer:
         return self.total / max(self.count, 1)
 
 
-# chip peaks for roofline reporting (bf16 TFLOPs, HBM GB/s)
+def _costs_module():
+    """``apex_tpu.monitor.costs`` WITHOUT triggering the monitor package
+    ``__init__`` (which imports telemetry → this module: a cycle, and
+    ``apex_tpu/__init__`` imports utils before monitor). The module is
+    import-time stdlib-only by contract, so a direct by-path load is
+    cheap; registered under its canonical name so the package import
+    later reuses this instance instead of making a second copy."""
+    import importlib.util
+    import os
+    import sys
+
+    mod = sys.modules.get("apex_tpu.monitor.costs")
+    if mod is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "monitor", "costs.py")
+        spec = importlib.util.spec_from_file_location(
+            "apex_tpu.monitor.costs", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["apex_tpu.monitor.costs"] = mod
+        spec.loader.exec_module(mod)
+    return mod
+
+
+# chip peaks for roofline reporting (bf16 TFLOPs, HBM GB/s) — derived
+# from the ledger's chip-spec table (monitor/costs.py owns the numbers;
+# the "cpu" fallback entry is non-gating there and excluded here, where
+# peaks always mean real silicon)
 CHIP_PEAKS = {
-    "v5e": {"hbm_gbps": 819.0, "tflops": 197.0},
-    "v6e": {"hbm_gbps": 1640.0, "tflops": 918.0},
-    "v5p": {"hbm_gbps": 2765.0, "tflops": 459.0},
+    chip: {"hbm_gbps": spec["hbm_gbps"], "tflops": spec["tflops"]}
+    for chip, spec in _costs_module().CHIP_SPECS.items() if spec["gating"]
 }
 
 # device_kind substrings → CHIP_PEAKS generation, most specific first
@@ -209,10 +235,9 @@ def roofline(fn, *args, chip: str | None = None,
             or os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"))
     peaks = CHIP_PEAKS.get(chip, CHIP_PEAKS["v5e"])
     compiled = jax.jit(fn).lower(*args).compile()
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
-    flops = float(ca.get("flops", 0.0))
-    nbytes = float(ca.get("bytes accessed", 0.0))
+    rec = _costs_module().xla_cost_record(compiled) or {}
+    flops = rec.get("flops", 0.0)
+    nbytes = rec.get("bytes_accessed", 0.0)
     t_mxu = flops / (peaks["tflops"] * 1e12) * 1e3
     t_hbm = nbytes / (peaks["hbm_gbps"] * 1e9) * 1e3
     out = {"chip": chip, "flops": flops, "bytes": nbytes,

@@ -213,6 +213,30 @@ def test_apx001_boundary_functions_end_the_traversal(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx001_named_scope_is_not_a_traced_effect(tmp_path):
+    """``jax.named_scope`` is pure trace-time metadata (it names the
+    lowered StableHLO ``loc(...)`` scopes the cost ledger attributes
+    phases on — PR 17) and must stay OUT of APX001's effect catalog:
+    the annotated GPT-2 forwards use it inside jitted code everywhere.
+    The fire half of the pair proves the rule still sees this fixture."""
+    _fixture(tmp_path, "apex_tpu/scoped.py", """\
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, w):
+            with jax.named_scope("ln_qkv"):
+                y = x @ w
+            with jax.named_scope("mlp"):
+                y = jnp.tanh(y)
+            print("leaked")
+            return y
+        """)
+    active, _ = _run(tmp_path, "APX001")
+    assert len(active) == 1                  # the print, nothing else
+    assert "print() is a host effect" in active[0].message
+
+
 def test_apx002_fires_on_lock_free_rmw(tmp_path):
     _fixture(tmp_path, "apex_tpu/counter.py", """\
         import threading

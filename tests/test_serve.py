@@ -166,9 +166,17 @@ def test_aot_compile_then_serve_traces_once(params):
     sched.run()
     assert eng.decode_traces == 1      # served entirely from the AOT exe
     assert eng.prefill_traces == 1
-    # reset drops state but keeps the compiled artifacts
+    # reset drops state but keeps the compiled artifacts — including
+    # the retained prefill LOWERINGS (PR 17): cost_ledger() on the warm-
+    # restarted engine extracts from the saved artifacts, never
+    # re-tracing or re-lowering
+    assert set(eng._prefill_lowered) == {8}
     eng.reset()
     assert np.asarray(eng.cache.lengths).max() == 0
+    assert set(eng._prefill_lowered) == {8}
+    ledger = eng.cost_ledger()
+    assert set(ledger["executables"]) == {"decode", "prefill_8"}
+    assert eng.decode_traces == 1 and eng.prefill_traces == 1
     sched = ServeScheduler(eng)
     sched.submit(Request(request_id="again", tokens=_tokens(6),
                          max_new_tokens=2))

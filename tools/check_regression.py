@@ -71,7 +71,7 @@ from typing import Dict, List, Optional, Tuple
 EXCLUDED = {"step", "t", "bench_wall_s", "fetch_floor_ms", "found_inf",
             "loss_scale", "grad_norm", "param_norm", "update_norm",
             "steps", "slots"}
-_LOWER_SUFFIXES = ("_ms", "_s", "_latency")
+_LOWER_SUFFIXES = ("_ms", "_s", "_us", "_latency")
 # serving latency names beat the generic rules ("ttft" carries no unit
 # suffix when reported in seconds; p50/p99 quantile columns are latencies).
 # Overload SLO counters are failure rates: more shed/rejected/expired
@@ -100,14 +100,23 @@ _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 # autoscale up/down counts are control-loop churn — a
                 # 0 -> N refusal storm or a flapping autoscaler gates
                 # off a zero baseline, never reads as neutral
-                "handoff_refused", "autoscale")
+                "handoff_refused", "autoscale",
+                # cost-ledger families (PR 17): static per-step work.
+                # These are DEVICE-INDEPENDENT — a fusion that claims a
+                # win must move flops/bytes/op-count, and a regression
+                # here is real work growth no wall-clock noise excuses
+                "flops_per_token", "hbm_bytes_per_token", "ops_total")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated
 # prefill, which is strictly worse.
 _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
                  "vs_baseline", "goodput", "imgs", "tokens", "seqs",
-                 "hit_rate")
+                 "hit_rate",
+                 # cost-ledger roofline bound (PR 17): a predicted-MFU
+                 # drop means the step moved toward memory-bound — worse
+                 # ("mfu" already matches, listed for the explicit record)
+                 "predicted_mfu")
 # failure fractions beat the generic "_frac" higher family (the mirror
 # of the hit_rate-vs-_rate precedent): a snapshot's shed_frac or
 # deadline_miss_frac going UP is strictly worse — without the override
@@ -156,8 +165,10 @@ def metrics_from_jsonl(lines: List[dict], warmup: int) -> Dict[str, Tuple[float,
 
 
 METRICS_SNAPSHOT_SCHEMA = "apex_tpu.metrics/v1"
+COST_LEDGER_SCHEMA = "apex_tpu.cost_ledger/v1"
 
 _EXPORT_MOD = None
+_COSTS_MOD = None
 
 
 def _export_module():
@@ -180,6 +191,39 @@ def _export_module():
         spec.loader.exec_module(mod)
         _EXPORT_MOD = mod
     return _EXPORT_MOD
+
+
+def _costs_module():
+    """Load ``apex_tpu/monitor/costs.py`` by file path (the
+    ``_export_module`` pattern): import-time stdlib-only by contract, so
+    the gate keeps running jax-free, and the ONE spelling of the
+    ledger's gate-metric / incomparability rules lives in costs.py —
+    shared with ``tools/cost_diff.py`` and ``Engine.cost_ledger()``."""
+    global _COSTS_MOD
+    if _COSTS_MOD is None:
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "apex_tpu", "monitor", "costs.py")
+        spec = importlib.util.spec_from_file_location(
+            "_apex_tpu_costs_gate", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _COSTS_MOD = mod
+    return _COSTS_MOD
+
+
+def metrics_from_ledger(doc: dict) -> Dict[str, Tuple[float, Optional[str]]]:
+    """Gateable metrics from an ``apex_tpu.cost_ledger/v1`` document,
+    prefixed ``cost_ledger.`` so the per-kernel summary groups them as
+    one entry. The families are the device-independent ones
+    (``decode_flops_per_token`` / ``decode_hbm_bytes_per_token`` /
+    ``decode_ops_total``, per-phase splits) plus the roofline
+    projections when the chip spec is a gating one (never the cpu
+    fallback) — ``costs.ledger_gate_metrics`` owns the selection."""
+    gm = _costs_module().ledger_gate_metrics(doc)
+    return {f"cost_ledger.{k}": (float(v), None)
+            for k, v in sorted(gm.items())}
 
 
 def _snapshot_quantile(buckets: Dict[int, int], count: int, p: float,
@@ -269,6 +313,8 @@ def load_metrics(path: str, warmup: int) -> Dict[str, Tuple[float, Optional[str]
         if isinstance(doc, dict):
             if doc.get("schema") == METRICS_SNAPSHOT_SCHEMA:
                 return metrics_from_snapshot(doc)
+            if doc.get("schema") == COST_LEDGER_SCHEMA:
+                return metrics_from_ledger(doc)
             # a one-row telemetry JSONL is also a single JSON dict —
             # disambiguate by shape (suite entries are dicts with "value")
             is_suite = any(isinstance(v, dict) and "value" in v
@@ -297,10 +343,10 @@ def capture_provenance(path: str) -> Dict[str, object]:
         return {}
     if not isinstance(doc, dict):
         return {}
-    if doc.get("schema") == METRICS_SNAPSHOT_SCHEMA:
-        # snapshots stamp provenance under "meta" (apex-tpu-bench passes
-        # capture_provenance() through), so the device-mismatch guard
-        # covers snapshot-vs-snapshot and snapshot-vs-suite gates too
+    if doc.get("schema") in (METRICS_SNAPSHOT_SCHEMA, COST_LEDGER_SCHEMA):
+        # snapshots AND cost ledgers stamp provenance under "meta"
+        # (apex-tpu-bench passes capture_provenance() through), so the
+        # device-mismatch guard covers them against any other format
         doc = doc.get("meta") or {}
         if not isinstance(doc, dict):
             return {}
@@ -402,6 +448,19 @@ def incomparable_entries(cur_doc: dict, base_doc: dict) -> Dict[str, str]:
                              f"workload.{key}={b}")
                 break    # first differing axis names the refusal
     return out
+
+
+def _ledger_doc(path: str) -> Optional[dict]:
+    """The raw cost-ledger document at ``path`` (None for everything
+    else)."""
+    try:
+        with open(path) as f:
+            doc = json.loads(f.read())
+    except (ValueError, OSError):
+        return None
+    if isinstance(doc, dict) and doc.get("schema") == COST_LEDGER_SCHEMA:
+        return doc
+    return None
 
 
 def _suite_doc(path: str) -> Optional[dict]:
@@ -552,6 +611,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                        if k != name and k.split(".", 1)[0] != name}
             baseline = {k: v for k, v in baseline.items()
                         if k != name and k.split(".", 1)[0] != name}
+
+    # the same refusal discipline for cost ledgers: the incomparability
+    # axes live in the ledger's own workload provenance (tp / tp_sync /
+    # page_size / dtype / num_slots / max_len / chip_spec —
+    # costs.LEDGER_INCOMPARABLE_KEYS), so a tp=2 or bf16 ledger never
+    # gates its per-token work against a single-chip fp32 baseline
+    cur_led, base_led = _ledger_doc(args.current), _ledger_doc(baseline_path)
+    if cur_led is not None and base_led is not None:
+        for reason in _costs_module().provenance_mismatch(cur_led,
+                                                          base_led):
+            print(f"INCOMPARABLE [cost_ledger] {reason} — refusing to "
+                  f"gate this ledger (different workloads price "
+                  f"different steps)")
+            current = {k: v for k, v in current.items()
+                       if not k.startswith("cost_ledger.")}
+            baseline = {k: v for k, v in baseline.items()
+                        if not k.startswith("cost_ledger.")}
 
     results, skipped = compare(current, baseline, args.tolerance,
                                args.metric)

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Diff two compiled-step cost ledgers — the fusion sweep's before/after
+oracle.
+
+``apex-tpu-bench --serve --cost-ledger`` writes a provenance-stamped
+``apex_tpu.cost_ledger/v1`` document (see ``apex_tpu/monitor/costs.py``
+and docs/performance.md "Cost ledgers and roofline gating"). This tool
+renders what moved between two of them: the derived per-token families,
+then per executable the totals, the per-phase attribution
+(``ln_qkv`` / ``attention`` / ``mlp`` / ``sampling`` / ``collective`` /
+``other``), and every op family whose count changed. A real fusion must
+move bytes/flops/op-count here — wall clock is not consulted.
+
+Usage::
+
+    python tools/cost_diff.py CURRENT.json BASELINE.json [--json]
+
+Exit status: 0 diff printed (improvements and regressions alike — the
+GATE is tools/check_regression.py; this is the attribution lens), 2 on
+a provenance mismatch (different tp/tp_sync/page_size/dtype/slot
+count/chip spec — the two ledgers price different steps, and a diff
+would attribute the workload delta to code) or unreadable input.
+
+This tool is **standalone**: it loads ``monitor/costs.py`` by file path
+(the ``metrics_merge.py`` pattern), so it runs on a machine with no jax
+installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_costs_module():
+    """Load ``apex_tpu/monitor/costs.py`` WITHOUT importing the
+    ``apex_tpu`` package (whose __init__ pulls jax): the module is
+    deliberately stdlib-only at import time for exactly this caller."""
+    path = os.path.join(_REPO, "apex_tpu", "monitor", "costs.py")
+    spec = importlib.util.spec_from_file_location(
+        "_apex_tpu_costs_diff", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float) and v == int(v):
+        v = int(v)
+    return f"{v:g}" if isinstance(v, float) else str(v)
+
+
+def _row_line(label: str, row: dict) -> str:
+    ratio = f" ({row['ratio']:g}x)" if "ratio" in row else ""
+    return (f"  {label:38s} {_fmt(row['baseline']):>14s} -> "
+            f"{_fmt(row['current']):>14s}  delta={_fmt(row['delta'])}"
+            f"{ratio}")
+
+
+def render(diff: dict) -> List[str]:
+    lines: List[str] = []
+    if diff.get("derived"):
+        lines.append("derived (per-token / roofline):")
+        for k, row in diff["derived"].items():
+            lines.append(_row_line(k, row))
+    for name, ex in diff.get("executables", {}).items():
+        lines.append(f"[{name}] totals:")
+        for k, row in ex["total"].items():
+            lines.append(_row_line(k, row))
+        if ex["phases"]:
+            lines.append(f"[{name}] per phase:")
+            for ph, fields in ex["phases"].items():
+                for k, row in fields.items():
+                    lines.append(_row_line(f"{ph}.{k}", row))
+        if ex["op_families"]:
+            lines.append(f"[{name}] op families (changed only):")
+            for fam, row in ex["op_families"].items():
+                lines.append(_row_line(fam, row))
+    if not lines:
+        lines.append("cost_diff: ledgers are identical on every "
+                     "compared family")
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two cost ledgers per phase/op-family "
+                    "(exit 2 on provenance mismatch)")
+    ap.add_argument("current", help="fresh cost ledger JSON")
+    ap.add_argument("baseline", help="committed baseline ledger JSON")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the structured diff document instead of "
+                         "the rendered table")
+    args = ap.parse_args(argv)
+
+    costs = load_costs_module()
+    docs = []
+    for path in (args.current, args.baseline):
+        try:
+            with open(path) as f:
+                docs.append(json.load(f))
+        except OSError as e:
+            print(f"cost_diff: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        except ValueError as e:
+            print(f"cost_diff: {path} is not JSON: {e}", file=sys.stderr)
+            return 2
+    cur, base = docs
+    reasons = costs.provenance_mismatch(cur, base)
+    if reasons:
+        # diffing incomparable ledgers would attribute the workload
+        # delta (a different mesh, dtype, or slot count) to code — the
+        # check_regression refusal discipline, loudly
+        for reason in reasons:
+            print(f"cost_diff: INCOMPARABLE — {reason}", file=sys.stderr)
+        return 2
+    diff = costs.diff_ledgers(cur, base)
+    if args.json:
+        json.dump(diff, sys.stdout, sort_keys=True, indent=1,
+                  default=float)
+        sys.stdout.write("\n")
+    else:
+        for line in render(diff):
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
