@@ -379,7 +379,9 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  roles: "str | None" = None,
                  diurnal: bool = False,
                  cost_ledger: "str | None" = None,
-                 chip_spec: "str | None" = None) -> None:
+                 chip_spec: "str | None" = None,
+                 spec_draft_len: "int | None" = None,
+                 decode_policy: "str | None" = None) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -458,6 +460,21 @@ def _serve_bench(steps: int, num_slots: int = 4,
     if replicas < 1:
         raise SystemExit(f"apex-tpu-bench: --replicas {replicas} must "
                          f"be >= 1")
+    # speculative-decoding matrix (same discipline, same as
+    # apex-tpu-serve): refused in milliseconds, before any compile
+    if spec_draft_len is not None and spec_draft_len < 1:
+        raise SystemExit(
+            f"apex-tpu-bench: --spec-draft-len {spec_draft_len} must "
+            f"be >= 1 (it is the drafter's proposal width; omit the "
+            f"flag for one-token decode)")
+    spec_k = spec_draft_len or 0
+    if decode_policy is not None:
+        from apex_tpu.serve.spec import parse_policy
+
+        try:
+            parse_policy(decode_policy, spec_draft_len=spec_k)
+        except ValueError as e:
+            raise SystemExit(f"apex-tpu-bench: --decode-policy: {e}")
     # cost-ledger matrix (same inert/contradictory-flag discipline):
     # validated against the ledger module's own chip-spec table BEFORE
     # any params/compile work
@@ -645,7 +662,9 @@ def _serve_bench(steps: int, num_slots: int = 4,
                                        page_size=page_size,
                                        num_pages=num_pages,
                                        prefix_cache=prefix_cache,
-                                       tp=tp, tp_sync=tp_sync),
+                                       tp=tp, tp_sync=tp_sync,
+                                       spec_draft_len=spec_k,
+                                       decode_policy=decode_policy),
                           seed=0)
                    for _ in range(replicas)]
     except ValueError as e:
@@ -831,6 +850,19 @@ def _serve_bench(steps: int, num_slots: int = 4,
         s["prefix_hit_rate"] = round(prefix_hits / admitted, 4) \
             if admitted else 0.0
         s["peak_resident_tokens"] = peak_resident
+        # speculative aggregates the single path reads off its one
+        # scheduler summary; pooled over replicas here (fleet-wide
+        # tokens over fleet-wide slot-steps, NOT a mean of ratios)
+        slot_steps = sum(h.scheduler.decode_slot_steps
+                         for h in fleet.handles)
+        dec_tokens = sum(h.scheduler.decode_tokens
+                         for h in fleet.handles)
+        proposed = sum(h.scheduler.spec_proposed for h in fleet.handles)
+        accepted = sum(h.scheduler.spec_accepted for h in fleet.handles)
+        s["accepted_tokens_per_step"] = round(
+            dec_tokens / slot_steps, 4) if slot_steps else 0.0
+        s["spec_accept_rate"] = round(
+            accepted / proposed, 4) if proposed else 0.0
     else:
         kv_bytes = engine.kv_cache_bytes
     suite = {
@@ -882,6 +914,18 @@ def _serve_bench(steps: int, num_slots: int = 4,
             **({"trace_promoted": (harness.stats() if harness is not None
                                    else router.stats())["promoted"]}
                if trace_jsonl else {}),
+            # speculative captures only (all higher-is-better; the gate
+            # knows tokens/_per_s/accept_rate): tokens committed per
+            # verify step (1.0 is the one-token floor), the draft
+            # acceptance fraction, and the throughput restated under a
+            # spec-specific name so the gate can hold the speculative
+            # rate by name — one-token baselines simply skip all three,
+            # and the workload axes below make cross-config comparisons
+            # a refusal, not a skew
+            **({"accepted_tokens_per_step": s["accepted_tokens_per_step"],
+                "spec_accept_rate": s["spec_accept_rate"],
+                "spec_tokens_per_s": s["tokens_per_s"]}
+               if spec_k else {}),
             "bench_wall_s": round(wall, 3),
             # workload config nested as a dict: check_regression lifts
             # only numeric scalars, so a capture with different
@@ -940,7 +984,17 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          "trace_sample": (
                              1.0 if trace_sample is None
                              else trace_sample)
-                         if trace_jsonl else None},
+                         if trace_jsonl else None,
+                         # speculative provenance: a spec capture's
+                         # tokens/s rides draft-acceptance luck and its
+                         # step time carries draft_len + 1 positions —
+                         # the gate REFUSES to compare across these
+                         # axes (missing key = speculation off, the
+                         # pre-spec default, so legacy baselines refuse
+                         # rather than silently gate)
+                         "spec": bool(spec_k),
+                         "draft_len": spec_k,
+                         "decode_policy": decode_policy},
             # a subset capture, not the full committed suite
             "complete": False,
         },
@@ -1036,7 +1090,9 @@ def main() -> None:
                       if a.split("=", 1)[0] in ("--disagg", "--roles",
                                                 "--diurnal",
                                                 "--cost-ledger",
-                                                "--chip-spec")]
+                                                "--chip-spec",
+                                                "--spec-draft-len",
+                                                "--decode-policy")]
         if serve_only and not has_serve:
             # without --serve these would silently fall through to the
             # kernel bench — the inert-flag class this matrix refuses
@@ -1224,6 +1280,23 @@ def main() -> None:
                                  "chip generation (e.g. v5p, v6e; "
                                  "default: detected chip, else the non-"
                                  "gating cpu spec; needs --cost-ledger)")
+            ap.add_argument("--spec-draft-len", type=int, default=None,
+                            metavar="K",
+                            help="speculative decoding: host n-gram "
+                                 "drafts of K tokens per slot verified "
+                                 "by one compiled K+1-position step — "
+                                 "the entry gains accepted_tokens_per_"
+                                 "step / spec_accept_rate / spec_tokens"
+                                 "_per_s (higher-is-better) and spec "
+                                 "workload provenance the gate refuses "
+                                 "to compare across")
+            ap.add_argument("--decode-policy", default=None,
+                            metavar="POLICY",
+                            help="decode-policy seam: greedy | "
+                                 "top_p[=P] | min_p[=M] | spec(POLICY) "
+                                 "with optional ',t=T' (beam-like "
+                                 "policies are refused — no exact "
+                                 "per-token acceptance test exists)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -1249,7 +1322,9 @@ def main() -> None:
                          disagg=args.disagg, roles=args.roles,
                          diurnal=args.diurnal,
                          cost_ledger=args.cost_ledger,
-                         chip_spec=args.chip_spec)
+                         chip_spec=args.chip_spec,
+                         spec_draft_len=args.spec_draft_len,
+                         decode_policy=args.decode_policy)
         elif has_telemetry:
             import argparse
 

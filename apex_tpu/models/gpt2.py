@@ -152,7 +152,8 @@ def _affine_layer_norm(x, scale, bias, eps: float = 1e-5):
 
 
 def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
-                       write_mask, *, block_k=None):
+                       write_mask, *, block_k=None,
+                       final_scope: str = "sampling"):
     """One decode token per slot through GPT-2 with the serving KV cache.
 
     ``tokens``/``positions``/``write_mask``: ``[num_slots]`` (int32, int32,
@@ -161,7 +162,12 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
     ``0..positions[slot]``; masked-off slots compute garbage that is
     discarded and write nothing. Returns ``(logits [num_slots, vocab]
     fp32, cache)``. ``block_k`` is the decode-attention KV chunk
-    (autotuned via ``apex_tpu.tune`` when None).
+    (autotuned via ``apex_tpu.tune`` when None). ``final_scope`` names
+    the phase of the final LN + logits projection for the cost ledger:
+    decode/prefill feed the sampler ("sampling"); the speculative
+    verify step passes "verify" so its per-position logits work — the
+    verify step's own cost — is attributed to the verify phase and
+    phase reconciliation stays exact (monitor/costs.py).
 
     ``cache`` may be either layout: the slot-contiguous
     :class:`~apex_tpu.serve.kv_cache.KVCache` or the paged
@@ -224,7 +230,7 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
                                      blk["mlp_fc_b"].astype(dt),
                                      blk["mlp_proj_w"].astype(dt),
                                      blk["mlp_proj_b"].astype(dt))
-    with jax.named_scope("sampling"):
+    with jax.named_scope(final_scope):
         x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
         logits = jax.lax.dot_general(
             x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),
@@ -258,7 +264,8 @@ def _psum_halves_into(part, resid, bias, axis_name, ln=None):
 
 def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
                           cache, tokens, positions, write_mask, *,
-                          block_k=None, axis_name: str = "tp"):
+                          block_k=None, axis_name: str = "tp",
+                          final_scope: str = "sampling"):
     """The PER-RANK body of the tensor-parallel single-token forward —
     run under ``shard_map`` over the serving mesh (``apex_tpu.serve.tp``
     owns the param layout and specs). Heads are sharded: this rank sees
@@ -393,7 +400,7 @@ def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
                     # layer exit
                     x, _ = _psum_halves_into(attn_part + mlp_part, x,
                                              out_b + proj_b, axis_name)
-    with jax.named_scope("sampling"):
+    with jax.named_scope(final_scope):
         x = _affine_layer_norm(x, p["ln_f"]["weight"], p["ln_f"]["bias"])
         logits = jax.lax.dot_general(
             x, p["wte"].astype(dt), (((1,), (1,)), ((), ())),

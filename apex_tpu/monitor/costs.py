@@ -43,10 +43,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 LEDGER_SCHEMA = "apex_tpu.cost_ledger/v1"
 
-# the phase vocabulary of the annotated GPT-2 serving forwards; "other"
-# is the explicit remainder bucket (embedding lookup, cache advance,
-# PRNG plumbing) so phase sums always equal the executable total
-PHASES = ("ln_qkv", "attention", "mlp", "sampling", "collective", "other")
+# the phase vocabulary of the annotated GPT-2 serving forwards; "verify"
+# is the speculative verify step's own work (the final LN + logits
+# projection per verify position plus the in-graph acceptance test —
+# PR 18; absent from one-token executables); "other" is the explicit
+# remainder bucket (embedding lookup, cache advance, PRNG plumbing) so
+# phase sums always equal the executable total
+PHASES = ("ln_qkv", "attention", "mlp", "sampling", "verify",
+          "collective", "other")
 
 SYNC_MODES = ("exact", "overlap", "relaxed")
 
@@ -182,6 +186,12 @@ def _phase_resolver(text: str) -> Callable[[Optional[str]], str]:
     memo: Dict[str, str] = {}
 
     def from_path(name: str) -> Optional[str]:
+        # loc bodies quote SOURCE FILE paths too ("/a/verify/drive.py");
+        # a directory that happens to be named after a phase must not
+        # claim the op — only named_scope paths (last segment is the op
+        # primitive, never a filename) are phase evidence
+        if name.rsplit("/", 1)[-1].endswith((".py", ".pyi")):
+            return None
         for seg in reversed(name.split("/")):
             for ph in PHASES[:-1]:
                 if seg == ph or (seg.startswith(ph + "_")
@@ -692,6 +702,11 @@ def build_ledger(executables: Dict[str, Dict[str, Any]],
 LEDGER_INCOMPARABLE_KEYS = {
     "tp": 1, "tp_sync": None, "page_size": 0, "dtype": None,
     "num_slots": None, "max_len": None, "chip_spec": None,
+    # speculative decoding (PR 18): a verify-step ledger prices
+    # draft_len + 1 positions per step — never gate it against a
+    # one-token ledger. Missing keys = speculation off (pre-PR-18
+    # ledgers are one-token by construction).
+    "spec_draft_len": 0, "decode_policy": None,
 }
 
 

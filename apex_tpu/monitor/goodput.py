@@ -137,6 +137,12 @@ COUNTED_EVENTS = (
     # bound these — a flapping autoscaler shows up as a count storm)
     "serve_page_migrated", "serve_handoff_refused",
     "serve_replica_spawned", "serve_autoscale_up", "serve_autoscale_down",
+    # speculative decoding (serve.scheduler + serve.spec): per verify
+    # step, the batch's draft tokens that matched the target policy's
+    # own choices (committed beyond the one-token floor) vs those rolled
+    # back by cache-length truncation — counted, never timed: the cost
+    # of a rejection is already inside the verify step's wall time
+    "serve_spec_draft_accepted", "serve_spec_draft_rejected",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

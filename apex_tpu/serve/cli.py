@@ -221,7 +221,9 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                               top_k=args.top_k, page_size=args.page_size,
                               num_pages=args.num_pages,
                               prefix_cache=args.prefix_cache,
-                              tp=args.tp, tp_sync=args.tp_sync)
+                              tp=args.tp, tp_sync=args.tp_sync,
+                              spec_draft_len=args.spec_draft_len or 0,
+                              decode_policy=args.decode_policy)
     handles = []
     for i, (rid, role) in enumerate(replica_specs):
         try:
@@ -525,6 +527,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "interleaved with norm/residual compute), "
                          "relaxed (ONE deferred all-reduce per layer; "
                          "opt-in approximation)")
+    ap.add_argument("--spec-draft-len", type=int, default=None,
+                    metavar="K",
+                    help="speculative decoding: host n-gram drafter "
+                         "proposes K tokens per active slot and one "
+                         "compiled verify step (a K+1-position prefill "
+                         "at decode width) scores them — exact "
+                         "acceptance, greedy streams bit-identical to "
+                         "the one-token engine (docs/serving.md "
+                         "'Speculative decoding and the decode-policy "
+                         "zoo')")
+    ap.add_argument("--decode-policy", default=None, metavar="POLICY",
+                    help="per-request sampling policy seam: greedy | "
+                         "top_p[=P] | min_p[=M] | spec(POLICY), optional "
+                         "',t=T' temperature suffix; policy knobs ride "
+                         "the compiled calls as data, so mixing "
+                         "policies in one batch never retraces "
+                         "(beam-like policies are refused — no exact "
+                         "per-token acceptance test exists)")
     ap.add_argument("--stdin", action="store_true",
                     help="read one token-id request per input line")
     ap.add_argument("--aot", action="store_true",
@@ -591,6 +611,24 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"single chip has no collectives to overlap or relax)",
               file=sys.stderr)
         return 2
+
+    # speculative-decoding flag matrix, BEFORE any params/compile work
+    # (same PR-10 precedent): a draft width that cannot draft and a
+    # policy the acceptance oracle cannot verify are usage errors
+    if args.spec_draft_len is not None and args.spec_draft_len < 1:
+        print(f"apex-tpu-serve: --spec-draft-len {args.spec_draft_len} "
+              f"must be >= 1 (it is the drafter's proposal width; omit "
+              f"the flag for one-token decode)", file=sys.stderr)
+        return 2
+    spec_k = args.spec_draft_len or 0
+    if args.decode_policy is not None:
+        from apex_tpu.serve.spec import parse_policy
+        try:
+            parse_policy(args.decode_policy, spec_draft_len=spec_k)
+        except ValueError as e:
+            print(f"apex-tpu-serve: --decode-policy: {e}",
+                  file=sys.stderr)
+            return 2
 
     # disaggregation / autoscaler flag matrix, BEFORE any params or
     # compile work (PR-10 precedent: inert or contradictory combinations
@@ -807,7 +845,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          page_size=args.page_size,
                          num_pages=args.num_pages,
                          prefix_cache=args.prefix_cache,
-                         tp=args.tp, tp_sync=args.tp_sync),
+                         tp=args.tp, tp_sync=args.tp_sync,
+                         spec_draft_len=args.spec_draft_len or 0,
+                         decode_policy=args.decode_policy),
             seed=args.seed)
     except ValueError as e:
         # bad pool geometry (page_size vs max_len/block_k, undersized

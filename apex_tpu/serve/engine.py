@@ -62,6 +62,25 @@ the jitted step under a threaded PRNG key: the key is part of engine
 state, split in-graph, and returned — a fixed seed replays a stream
 bit-for-bit.
 
+**Speculative mode** (``EngineConfig(spec_draft_len=K)``) adds a FOURTH
+compiled artifact: ``verify`` — structurally the prefill scan over
+``K + 1`` positions (column 0 re-feeds the slot's last committed token,
+columns 1..K are host-side draft guesses from
+:class:`~apex_tpu.serve.spec.NGramDrafter`). Acceptance is exact and
+in-graph: position ``p``'s logits produce the target policy's own next
+token, a draft is committed iff it equals that target, and the leading
+match run plus one bonus token advance the slot — ``set_lengths``
+truncation rolls back every rejected draft row (the evict mechanism:
+K/V beyond ``lengths`` is unreachable because attention reachability is
+keyed on the position argument). Draft width is a static shape, the
+accepted length is data, so the invariant extends to one decode trace
+PLUS one verify trace per mesh shape (``verify_traces``), and a greedy
+speculative stream is bit-identical to the one-token engine — slot and
+paged, tp=1 and tp=2-exact. The ``DecodePolicy`` seam
+(``EngineConfig(decode_policy=...)``, :mod:`apex_tpu.serve.spec`)
+threads per-slot temperature/top_p/min_p as DATA through the same
+compiled calls for per-request policy mixing in one batch.
+
 ``aot_compile()`` lowers and compiles decode (and any requested prompt
 buckets) ahead of time — the serving analog of the repo's AOT tooling: no
 request ever pays a trace.
@@ -80,6 +99,7 @@ from apex_tpu.models.gpt2 import (GPT2Config, gpt2_token_forward,
                                   gpt2_token_forward_tp)
 from apex_tpu.ops.pallas.tiling import pow2_ceil
 from apex_tpu.serve import kv_cache, paging
+from apex_tpu.serve import spec as serve_spec
 from apex_tpu.serve import tp as serve_tp
 from apex_tpu.serve.attention import resolve_block_k
 from apex_tpu.serve.kv_cache import (init_cache, init_paged_cache,
@@ -129,6 +149,20 @@ class EngineConfig:
     # activations: ONE deferred all-reduce per layer; opt-in
     # approximation)
     tp_sync: str = "exact"
+    # speculative decoding (docs/serving.md "Speculative decoding and
+    # the decode-policy zoo"): static draft width per verify step; 0 is
+    # the one-token engine. The verify step scores draft_len + 1
+    # positions per slot in ONE compiled call; the accepted length is
+    # data, so the one-compile invariant survives speculation.
+    spec_draft_len: int = 0
+    # the DecodePolicy seam (apex_tpu.serve.spec): None keeps the legacy
+    # static sampler above (temperature/top_k baked into the trace) and
+    # the decode signature unchanged; a policy spelling ("greedy",
+    # "top_p[=P]", "min_p[=M]", "spec(POLICY)") arms per-slot policy
+    # mixing — per-slot temperature/top_p/min_p ride the compiled calls
+    # as [num_slots] f32 DATA, so mixing policies in one batch never
+    # retraces. Parse/validation errors are build-time ValueErrors.
+    decode_policy: Optional[str] = None
 
 
 class Engine:
@@ -219,14 +253,33 @@ class Engine:
                                        config.block_k,
                                        page_size=config.page_size,
                                        tp_shards=self._tp)
+        # speculative decoding + the DecodePolicy seam: every bad knob is
+        # a build-time ValueError (both CLIs surface them as exit 2
+        # before any compile)
+        self._spec_k = int(config.spec_draft_len or 0)
+        if self._spec_k < 0:
+            raise ValueError(
+                f"spec_draft_len={config.spec_draft_len} must be >= 0 "
+                f"(0 disables speculation)")
+        if self._spec_k and self._spec_k + 1 > self.max_len:
+            raise ValueError(
+                f"spec_draft_len={self._spec_k} needs max_len >= "
+                f"{self._spec_k + 1}: a verify step scores draft_len + 1 "
+                f"positions")
+        self._policy = (serve_spec.parse_policy(
+            config.decode_policy, spec_draft_len=self._spec_k)
+            if config.decode_policy is not None else None)
         self._init_state(seed)
 
         # trace counters: tier-1 asserts decode_traces == 1 across a full
         # admit/complete/evict/backfill trace (the one-jit invariant —
         # one compile per MESH SHAPE: a tp engine's single decode trace
-        # covers every rank, there is no per-rank compile to count)
+        # covers every rank, there is no per-rank compile to count).
+        # Speculation adds verify_traces with the identical contract:
+        # one verify trace per mesh shape, churn-proof.
         self.decode_traces = 0
         self.prefill_traces = 0
+        self.verify_traces = 0
 
         self._decode = jax.jit(self._decode_fn)
         self._decode_aot = None
@@ -238,6 +291,13 @@ class Engine:
         #                                contract as _decode_lowered: the
         #                                cost ledger reads prefill costs
         #                                without re-lowering after reset()
+        self._verify = jax.jit(self._make_verify()) if self._spec_k \
+            else None
+        self._verify_aot = None
+        self._verify_lowered = None    # retention contract shared with
+        #                                _decode_lowered: cost_ledger()
+        #                                prices verify after reset()
+        #                                without ever re-tracing
         if self._tp > 1:
             publish_event(
                 "serve_tp_mesh_ready", tp=self._tp,
@@ -246,8 +306,15 @@ class Engine:
                     self.tp_collectives_per_step().values()))
 
     # ------------------------------------------------------------ graphs
-    def _sample(self, logits, rng):
-        """Temperature / top-k sampling; greedy when temperature == 0."""
+    def _sample(self, logits, rng, pol=None):
+        """Temperature / top-k sampling; greedy when temperature == 0.
+        With the DecodePolicy seam armed, ``pol`` carries the per-slot
+        temperature/top_p/min_p arrays as data and the branchless
+        combined sampler runs instead (greedy rows stay an exact
+        argmax)."""
+        if pol is not None:
+            return serve_spec.sample_with_policy(
+                logits, rng, pol, top_k=int(self.config.top_k))
         t = float(self.config.temperature)
         k = int(self.config.top_k)
         if t <= 0.0:
@@ -258,11 +325,13 @@ class Engine:
             scaled = jnp.where(scaled < kth, jnp.float32(-1e30), scaled)
         return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
-    def _token_step(self, cache, tokens, positions, mask):
+    def _token_step(self, cache, tokens, positions, mask, *,
+                    final_scope: str = "sampling"):
         if self.mesh is None:
             return gpt2_token_forward(self.model_cfg, self.params, cache,
                                       tokens, positions, mask,
-                                      block_k=self.block_k)
+                                      block_k=self.block_k,
+                                      final_scope=final_scope)
         # tensor-parallel: the SAME call sites (decode_fn, the prefill
         # scan body) lower the per-rank forward under shard_map — the
         # cache rides in head-sharded, the page table/lengths replicated,
@@ -276,7 +345,8 @@ class Engine:
         def rank_body(params, cache, tokens, positions, mask):
             return gpt2_token_forward_tp(
                 self.model_cfg, self._tp, self.config.tp_sync, params,
-                cache, tokens, positions, mask, block_k=self.block_k)
+                cache, tokens, positions, mask, block_k=self.block_k,
+                final_scope=final_scope)
 
         fn = shard_map(rank_body, mesh=self.mesh,
                        in_specs=(self._tp_param_specs, specs, P(), P(),
@@ -284,21 +354,22 @@ class Engine:
                        out_specs=(P(), specs), check_vma=False)
         return fn(self._tp_params, cache, tokens, positions, mask)
 
-    def _decode_fn(self, cache, last_tokens, active, rng):
+    def _decode_fn(self, cache, last_tokens, active, rng, pol=None):
         self.decode_traces += 1          # python side effect: trace count
         positions = cache.lengths
         logits, cache = self._token_step(cache, last_tokens, positions,
                                          active)
         with jax.named_scope("sampling"):
             rng, sub = jax.random.split(rng)
-            next_tokens = self._sample(logits, sub)
+            next_tokens = self._sample(logits, sub, pol)
         cache = kv_cache.advance(cache, active)
         return next_tokens, logits, cache, rng
 
     def _make_prefill(self, bucket: int):
         keep = self.config.keep_prefill_logits
 
-        def prefill_fn(cache, tokens, admit, start, tail_lens, rng):
+        def prefill_fn(cache, tokens, admit, start, tail_lens, rng,
+                       pol=None):
             self.prefill_traces += 1
             cache = kv_cache.reset_slots(cache, admit)
 
@@ -325,21 +396,110 @@ class Engine:
             cache = kv_cache.set_lengths(cache, admit, start + tail_lens)
             with jax.named_scope("sampling"):
                 rng, sub = jax.random.split(rng)
-                first_tokens = self._sample(last_logits, sub)
+                first_tokens = self._sample(last_logits, sub, pol)
             return cache, first_tokens, last_logits, all_logits, rng
 
         return jax.jit(prefill_fn)
 
+    def _make_verify(self):
+        """The speculative verify step: structurally the prefill scan
+        over ``draft_len + 1`` positions at decode width. Column 0
+        re-feeds each slot's last committed token (exactly what
+        ``decode_step`` would feed), columns ``1..K`` are the host
+        drafter's guesses; position ``p``'s logits produce the target
+        policy's own next token, and a draft is accepted iff it EQUALS
+        that target (exact rejection-sampling acceptance for a
+        point-mass drafter — no tolerance, the fp32 prefill-vs-decode
+        bit-exactness IS the oracle). The accepted run length is data:
+        ``set_lengths`` commits ``accepted + 1`` tokens and thereby
+        rolls back every rejected draft row (stale K/V beyond
+        ``lengths`` is unreachable — attention reachability is keyed on
+        the position argument, the same mechanism evict relies on).
+        Per-slot ``draft_lens`` is also data, so capacity- or
+        budget-clamped slots (down to plain one-token steps at
+        ``draft_lens == 0``) ride the same trace."""
+        k = self._spec_k
+        width = k + 1
+
+        def verify_fn(cache, last_tokens, drafts, draft_lens, active,
+                      rng, pol=None):
+            self.verify_traces += 1      # python side effect: trace count
+            start = cache.lengths
+
+            def body(carry, p):
+                cache = carry
+                write = active & (p <= draft_lens)
+                positions = jnp.where(write, start + p, cache.lengths)
+                tokens = jnp.where(
+                    p == 0, last_tokens,
+                    drafts[:, jnp.maximum(p - 1, 0)])
+                logits, cache = self._token_step(
+                    cache, tokens, positions, write,
+                    final_scope="verify")
+                return cache, logits
+
+            cache, all_logits = jax.lax.scan(
+                body, cache, jnp.arange(width, dtype=jnp.int32))
+            with jax.named_scope("sampling"):
+                # ONE split of the engine key per verify call — the same
+                # key-path contract as decode, so sampling_state()
+                # journal replay covers speculative streams unchanged
+                rng, sub = jax.random.split(rng)
+                keys = jax.random.split(sub, width)
+                targets = jax.vmap(
+                    lambda lg, kk: self._sample(lg, kk, pol))(
+                        all_logits, keys)
+            targets = jnp.transpose(targets)          # [B, K+1]
+            with jax.named_scope("verify"):
+                proposed = (jnp.arange(k, dtype=jnp.int32)[None, :]
+                            < draft_lens[:, None])
+                match = (drafts == targets[:, :k]) & proposed
+                # leading run of matches: a rejection truncates the draft
+                accepted = jnp.cumprod(
+                    match.astype(jnp.int32), axis=1).sum(axis=1)
+                committed = jnp.where(active, accepted + 1, 0) \
+                    .astype(jnp.int32)
+                next_tokens = jnp.take_along_axis(
+                    targets, accepted[:, None], axis=1)[:, 0]
+                cache = kv_cache.set_lengths(cache, active,
+                                             start + committed)
+            return targets, committed, next_tokens, cache, rng
+
+        return verify_fn
+
     # -------------------------------------------------------------- AOT
+    def _policy_args(self):
+        """Per-slot policy knobs as a jit-argument pytree (DATA — new
+        values never retrace); None when the seam is unarmed, which
+        keeps every legacy trace signature byte-identical."""
+        if self._policy is None:
+            return None
+        return {"temps": jnp.asarray(self._pol_temps),
+                "top_ps": jnp.asarray(self._pol_top_ps),
+                "min_ps": jnp.asarray(self._pol_min_ps)}
+
     def _decode_args(self):
-        return (self.cache, jnp.zeros((self.config.num_slots,), jnp.int32),
+        args = (self.cache, jnp.zeros((self.config.num_slots,), jnp.int32),
                 jnp.zeros((self.config.num_slots,), bool), self.rng)
+        return args + ((self._policy_args(),)
+                       if self._policy is not None else ())
 
     def _prefill_args(self, bucket: int):
         b = self.config.num_slots
-        return (self.cache, jnp.zeros((b, bucket), jnp.int32),
+        args = (self.cache, jnp.zeros((b, bucket), jnp.int32),
                 jnp.zeros((b,), bool), jnp.zeros((b,), jnp.int32),
                 jnp.zeros((b,), jnp.int32), self.rng)
+        return args + ((self._policy_args(),)
+                       if self._policy is not None else ())
+
+    def _verify_args(self):
+        b = self.config.num_slots
+        args = (self.cache, jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, self._spec_k), jnp.int32),
+                jnp.zeros((b,), jnp.int32), jnp.zeros((b,), bool),
+                self.rng)
+        return args + ((self._policy_args(),)
+                       if self._policy is not None else ())
 
     def aot_compile(self, prompt_buckets: Sequence[int] = ()) -> "Engine":
         """Lower + compile decode (and the given prompt-length buckets)
@@ -379,6 +539,17 @@ class Engine:
                     "serve_prefill", self._prefill_aot[bucket],
                     bucket=bucket, num_slots=self.config.num_slots,
                     max_len=self.max_len)
+        if self._spec_k and self._verify_aot is None:
+            # retained like _decode_lowered: cost_ledger() prices the
+            # verify step from the saved lowering after reset()
+            self._verify_lowered = self._verify.lower(
+                *self._verify_args())
+            self._verify_aot = self._verify_lowered.compile()
+            publish_compiled_memory(
+                "serve_verify", self._verify_aot,
+                draft_len=self._spec_k,
+                num_slots=self.config.num_slots, max_len=self.max_len,
+                page_size=self.config.page_size or 0)
         return self
 
     def _init_state(self, seed: int) -> None:
@@ -427,6 +598,15 @@ class Engine:
         self.prefix_hits = 0             # prompts that reused >=1 page
         self.prefix_hit_tokens = 0       # tokens served from the index
         self.last_prefill_stats: Dict[int, Dict[str, int]] = {}
+        if self._policy is not None:
+            # per-slot policy knobs (host mirrors of the jit-argument
+            # arrays): reset() restores the engine-default policy
+            self._pol_temps = np.full((b,), self._policy.temperature,
+                                      np.float32)
+            self._pol_top_ps = np.full((b,), self._policy.top_p,
+                                       np.float32)
+            self._pol_min_ps = np.full((b,), self._policy.min_p,
+                                       np.float32)
 
     def reset(self, seed: int = 0, *,
               keep_prefix_cache: bool = False) -> "Engine":
@@ -668,9 +848,11 @@ class Engine:
         if fn is None:
             fn = self._prefill_jits.setdefault(
                 bucket, self._make_prefill(bucket))
-        self.cache, first, last_logits, all_logits, self.rng = fn(
-            self.cache, jnp.asarray(tokens), jnp.asarray(admit),
-            jnp.asarray(starts), jnp.asarray(lens), self.rng)
+        args = (self.cache, jnp.asarray(tokens), jnp.asarray(admit),
+                jnp.asarray(starts), jnp.asarray(lens), self.rng)
+        if self._policy is not None:
+            args += (self._policy_args(),)
+        self.cache, first, last_logits, all_logits, self.rng = fn(*args)
         self.prefill_calls += 1
         self.prefill_requests += len(prompts)
         self.prefill_scanned_tokens += int(bucket)
@@ -709,13 +891,106 @@ class Engine:
         fn = self._decode_aot or self._decode
         lt = jnp.asarray(np.asarray(last_tokens, np.int32))
         act = jnp.asarray(act_np)
-        next_tokens, logits, self.cache, self.rng = fn(
-            self.cache, lt, act, self.rng)
+        args = (self.cache, lt, act, self.rng)
+        if self._policy is not None:
+            args += (self._policy_args(),)
+        next_tokens, logits, self.cache, self.rng = fn(*args)
         self.decode_calls += 1
         next_np = np.asarray(next_tokens)
         self.last_tokens = np.where(act_np, next_np, self.last_tokens)
         self._host_lengths = self._host_lengths + act_np
         return next_np, logits
+
+    # ------------------------------------------------ speculative decode
+    @property
+    def spec_draft_len(self) -> int:
+        """Static draft width K (0 = speculation off)."""
+        return self._spec_k
+
+    @property
+    def policy_armed(self) -> bool:
+        """True when the DecodePolicy seam threads per-slot knobs."""
+        return self._policy is not None
+
+    def set_slot_policy(self, slot: int, policy=None) -> None:
+        """Install a per-request decode policy on ``slot`` (policy
+        mixing in one batch): the knobs are DATA on the compiled calls,
+        so this never retraces. ``policy`` is a
+        :class:`~apex_tpu.serve.spec.DecodePolicy`, a policy spelling,
+        or None to restore the engine default. Needs
+        ``EngineConfig(decode_policy=...)`` — the unarmed engine's
+        sampler is baked into the trace."""
+        if self._policy is None:
+            if policy is None:
+                return
+            raise ValueError(
+                "per-slot policies need EngineConfig(decode_policy=...): "
+                "the unarmed engine bakes its sampler into the trace")
+        pol = policy if policy is not None else self._policy
+        if isinstance(pol, str):
+            pol = serve_spec.parse_policy(pol,
+                                          spec_draft_len=self._spec_k)
+        self._pol_temps[slot] = pol.temperature
+        self._pol_top_ps[slot] = pol.top_p
+        self._pol_min_ps[slot] = pol.min_p
+
+    def spec_headroom(self, slot: int) -> int:
+        """Cache rows still writable for ``slot`` (admitted capacity
+        minus resident tokens) — the scheduler clamps each tick's draft
+        to ``headroom - 1`` so a verify commit can never overrun."""
+        return int(self._slot_capacity[slot] - self._host_lengths[slot])
+
+    def spec_decode_step(self, last_tokens, drafts, draft_lens, active):
+        """One speculative step for every slot: feed each active slot
+        its last committed token plus up to ``spec_draft_len`` host
+        draft guesses; the compiled verify step scores all ``K + 1``
+        positions and commits the exactly-accepted run plus one bonus
+        token. ``drafts`` ``[num_slots, K]`` int, ``draft_lens``
+        ``[num_slots]`` int in ``[0, K]`` (data — a 0 row is a plain
+        one-token step on the same trace), ``active`` ``[num_slots]``
+        bool. Returns ``(committed [num_slots, K + 1] np.ndarray — only
+        the first ``counts[slot]`` entries of each row are meaningful —
+        and counts [num_slots] np.ndarray)``."""
+        if not self._spec_k:
+            raise ValueError(
+                "spec_decode_step needs EngineConfig(spec_draft_len >= "
+                "1); use decode_step on the one-token engine")
+        act_np = np.asarray(active, bool)
+        dl_np = np.asarray(draft_lens, np.int64)
+        if ((dl_np < 0) | (dl_np > self._spec_k)).any():
+            raise ValueError(
+                f"draft_lens {dl_np.tolist()} must lie in "
+                f"[0, spec_draft_len={self._spec_k}]")
+        # capacity backstop, mirroring decode_step's refusal: the verify
+        # scan writes positions length..length+draft_len, and commits up
+        # to draft_len + 1 tokens — an overrun would clip (slot cache)
+        # or land in an unreserved page (paged) and corrupt K/V rows
+        need = self._host_lengths + np.where(act_np, dl_np + 1, 0)
+        over = act_np & (need > self._slot_capacity)
+        if over.any():
+            raise ValueError(
+                f"slot(s) {np.flatnonzero(over).tolist()} would overrun "
+                f"their admitted capacity "
+                f"{self._slot_capacity[over].tolist()} at draft_lens="
+                f"{dl_np[over].tolist()} (max_len={self.max_len}); clamp "
+                f"the draft or evict before speculating further")
+        fn = self._verify_aot or self._verify
+        b = self.config.num_slots
+        args = (self.cache, jnp.asarray(np.asarray(last_tokens, np.int32)),
+                jnp.asarray(np.asarray(drafts, np.int32).reshape(
+                    b, self._spec_k)),
+                jnp.asarray(dl_np.astype(np.int32)), jnp.asarray(act_np),
+                self.rng)
+        if self._policy is not None:
+            args += (self._policy_args(),)
+        committed, counts, next_tokens, self.cache, self.rng = fn(*args)
+        self.decode_calls += 1
+        committed_np = np.asarray(committed)
+        counts_np = np.asarray(counts)
+        self.last_tokens = np.where(act_np, np.asarray(next_tokens),
+                                    self.last_tokens)
+        self._host_lengths = self._host_lengths + counts_np
+        return committed_np, counts_np
 
     def evict(self, slots) -> None:
         """Free the given slot indices (mask-shaped op, compiled once);
@@ -861,14 +1136,18 @@ class Engine:
         never re-tracing (``decode_traces`` stays at 1), and surviving
         ``reset()``/warm restarts, which keep the compiled artifacts.
         Entries: ``decode`` plus ``prefill_<bucket>`` for every bucket
-        already compiled or requested via ``prompt_buckets``.
+        already compiled or requested via ``prompt_buckets``, plus
+        ``verify`` when speculation is armed (``spec_draft_len >= 1``;
+        a one-token engine's ledger is byte-identical to PR 17's —
+        there is no verify artifact to price).
         """
         from apex_tpu.monitor import costs
         from apex_tpu.utils.prof import detect_chip
 
         if self._decode_lowered is None or any(
                 pow2_ceil(int(b)) not in self._prefill_lowered
-                for b in prompt_buckets):
+                for b in prompt_buckets) or (
+                    self._spec_k and self._verify_lowered is None):
             self.aot_compile(prompt_buckets)
         execs = {"decode": costs.executable_record(
             self._decode_lowered, self._decode_aot)}
@@ -876,6 +1155,9 @@ class Engine:
             execs[f"prefill_{bucket}"] = costs.executable_record(
                 self._prefill_lowered[bucket],
                 self._prefill_aot.get(bucket))
+        if self._spec_k:
+            execs["verify"] = costs.executable_record(
+                self._verify_lowered, self._verify_aot)
         dtype = jnp.dtype(self.model_cfg.compute_dtype)
         workload = {
             "model": "gpt2",
@@ -891,6 +1173,8 @@ class Engine:
             "n_embd": int(self.model_cfg.n_embd),
             "n_head": int(self.model_cfg.n_head),
             "vocab_size": int(self.model_cfg.vocab_size),
+            "spec_draft_len": int(self._spec_k),
+            "decode_policy": self.config.decode_policy,
         }
         return costs.build_ledger(execs, workload,
                                   chip=chip or detect_chip() or "cpu")

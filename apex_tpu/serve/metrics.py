@@ -29,6 +29,8 @@ serve_requests_evicted_total              counter    tenant
 serve_deadline_exceeded_total             counter    tenant
 serve_prefix_hits_total                   counter    tenant
 serve_generated_tokens_total              counter    tenant
+serve_spec_accept_rate                    histogram  tenant
+serve_tokens_per_decode_step              gauge      tenant (merge: max)
 serve_ttft_seconds                        histogram  tenant
 serve_latency_seconds                     histogram  tenant
 serve_queue_wait_seconds                  histogram  tenant
@@ -49,7 +51,7 @@ and fleet aggregation".
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Mapping, Optional
 
 from apex_tpu.monitor.export import MetricsRegistry
 
@@ -94,6 +96,18 @@ class ServeMetrics:
         self.generated = r.counter(
             "serve_generated_tokens_total",
             "tokens generated for terminal requests", t, n)
+        # speculative decoding (PR-18): accept rate is a per-(slot, step)
+        # sample in [0, 1] — quantiles answer "how often do drafts land
+        # for THIS tenant", which a run-total ratio hides; the gauge is
+        # the live tokens-per-step multiplier the autoscaler reads
+        self.spec_accept = r.histogram(
+            "serve_spec_accept_rate",
+            "per-step draft acceptance fraction (speculative decode)",
+            t, n)
+        self.tokens_per_step = r.gauge(
+            "serve_tokens_per_decode_step",
+            "tokens committed per decode step, last tick", t, n,
+            agg="max")
         self.ttft = r.histogram(
             "serve_ttft_seconds", "submit to first token", t, n)
         self.latency = r.histogram(
@@ -138,6 +152,23 @@ class ServeMetrics:
 
     def on_prefix_hit(self, req, hit_tokens: int) -> None:
         self.prefix_hits.inc(tenant=self._tenant(req))
+
+    def on_spec(self, req, *, proposed: int, accepted: int) -> None:
+        """One slot's draft outcome for one verify step (speculative
+        decode only; ticks where the scheduler clamped the draft to zero
+        contribute no sample — there was nothing to accept)."""
+        if proposed > 0:
+            self.spec_accept.record(accepted / proposed,
+                                    tenant=self._tenant(req))
+
+    def on_spec_step(self, tenant_tokens: Mapping[Any, int]) -> None:
+        """Tokens committed per tenant in one verify step — sets the live
+        ``serve_tokens_per_decode_step`` gauge (1.0 is the one-token
+        floor; > 1 is speculation paying off)."""
+        for tenant, tokens in tenant_tokens.items():
+            self.tokens_per_step.set(
+                float(tokens),
+                tenant=str(tenant) if tenant else DEFAULT_TENANT)
 
     def on_complete(self, req) -> None:
         tenant = self._tenant(req)

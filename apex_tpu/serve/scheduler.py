@@ -90,6 +90,7 @@ import numpy as np
 
 from apex_tpu.monitor.export import percentile
 from apex_tpu.serve.engine import Engine
+from apex_tpu.serve.spec import NGramDrafter
 from apex_tpu.utils.logging import publish_event
 
 # a request in one of these states has reached its exactly-one terminal
@@ -122,6 +123,12 @@ class Request:
     # behavior: one standalone "request:<id>" trace per request
     trace_id: Optional[str] = None
     trace_parent: Optional[int] = None
+    # per-request decode policy (the DecodePolicy seam,
+    # apex_tpu.serve.spec): a policy spelling installed on the slot at
+    # admission, so one batch mixes greedy/top_p/min_p requests on one
+    # trace. None = the engine's default policy; needs
+    # EngineConfig(decode_policy=...).
+    policy: Optional[str] = None
 
     # filled in by the scheduler
     generated: List[int] = dataclasses.field(default_factory=list)
@@ -194,6 +201,13 @@ class ServeStats:
     admitted: int = 0           # requests that reached a slot
     prefix_hits: int = 0        # admissions that reused resident pages
     peak_resident_tokens: int = 0  # max cache tokens live at once
+    # speculative decoding: active slot-steps (one slot taking one
+    # decode/verify step), drafts proposed, drafts accepted — the
+    # acceptance accounting behind accepted_tokens_per_step (exactly 1.0
+    # on the one-token path, > 1 when speculation earns its keep)
+    decode_slot_steps: int = 0
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     def summary(self) -> Dict[str, Any]:
         # ONE percentile rule for every field: the exact nearest-rank
@@ -238,6 +252,18 @@ class ServeStats:
             # not the run's admission pattern
             "tokens_per_s": round(
                 self.decode_tokens / decode_s, 3) if decode_s else 0.0,
+            # speculative throughput: committed tokens per SLOT-step —
+            # 1.0 exactly on the one-token path (and for a drafter that
+            # never guesses right), > 1 when verified drafts multiply
+            # each compiled step. check_regression gates it
+            # higher-is-better; the spec workload axes make speculative
+            # captures refuse to gate against one-token baselines.
+            "accepted_tokens_per_step": round(
+                self.decode_tokens / self.decode_slot_steps, 4)
+            if self.decode_slot_steps else 0.0,
+            "spec_accept_rate": round(
+                self.spec_accepted / self.spec_proposed, 4)
+            if self.spec_proposed else 0.0,
             "p50_step_ms": round(percentile(lat, 0.50) * 1e3, 3),
             "p99_step_ms": round(percentile(lat, 0.99) * 1e3, 3),
             "ttft_p50_ms": round(percentile(ttfts, 0.50) * 1e3, 3),
@@ -271,12 +297,20 @@ class ServeScheduler:
 
     def __init__(self, engine: Engine, *, fault_injector=None,
                  tracer=None, flight_recorder=None, memory_accountant=None,
-                 admission=None, journal=None, metrics=None):
+                 admission=None, journal=None, metrics=None, drafter=None):
         self.engine = engine
         self.injector = fault_injector
         self.admission = admission
         self.journal = journal
         self.restarts = 0
+        # speculative decoding: the host-side drafter proposes each
+        # tick's draft tokens (injectable — tests script pathological
+        # drafters; correctness never depends on it, the engine's verify
+        # step accepts exactly). Defaults to the n-gram prompt-lookup
+        # drafter whenever the engine is built with spec_draft_len >= 1.
+        self.drafter = drafter
+        if self.drafter is None and engine.spec_draft_len:
+            self.drafter = NGramDrafter()
         # observability seams (all optional; None = zero work per tick)
         self.tracer = tracer if tracer is not None and tracer.enabled \
             else None
@@ -302,6 +336,9 @@ class ServeScheduler:
         self.decode_steps = 0
         self.decode_step_s: List[float] = []
         self.decode_tokens = 0
+        self.decode_slot_steps = 0    # active slots × decode steps
+        self.spec_proposed = 0        # draft tokens offered to verify
+        self.spec_accepted = 0        # draft tokens the oracle accepted
         self.admitted = 0             # requests that reached a slot
         self.prefix_hits = 0          # admissions served partly from the
         #                               paged prefix index
@@ -453,6 +490,16 @@ class ServeScheduler:
         for slot, req in batch.items():
             req.admit_t = now
             req.state = "running"
+            if self.drafter is not None and \
+                    hasattr(self.drafter, "observe"):
+                # cross-request prompt lookup: admitted prompts feed the
+                # drafter's corpus (host state only — admission order is
+                # deterministic, so drafts are too)
+                self.drafter.observe(req.tokens)
+            if self.engine.policy_armed:
+                # per-request policy mixing: the slot's knobs are DATA
+                # on the compiled calls — installing them never retraces
+                self.engine.set_slot_policy(slot, req.policy)
             wait = max(now - req.submit_t - req.wait_charged, 0.0)
             req.wait_charged += wait
             self.admitted += 1
@@ -537,6 +584,81 @@ class ServeScheduler:
             self._finish(req, "length")
         elif len(req.tokens) + len(req.generated) >= self.engine.max_len:
             self._finish(req, "context")
+
+    # ------------------------------------------------------- speculation
+    def _build_drafts(self, spec_k: int):
+        """Each active slot's host draft for this tick, clamped so the
+        verify commit (up to ``draft_len + 1`` tokens) can never overrun
+        the request's token budget, the model context, or the slot's
+        admitted cache capacity — a fully clamped slot runs a plain
+        one-token step on the SAME verify trace (``draft_len`` is
+        data)."""
+        # caller holds self._lock (step())
+        b = self.engine.config.num_slots
+        drafts = np.zeros((b, spec_k), np.int32)
+        draft_lens = np.zeros((b,), np.int32)
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            budget = req.budget if req.budget is not None \
+                else req.max_new_tokens
+            room = min(budget - len(req.generated),
+                       self.engine.max_len - len(req.tokens)
+                       - len(req.generated),
+                       self.engine.spec_headroom(slot))
+            k = max(min(spec_k, room - 1), 0)
+            if k:
+                d = self.drafter.draft(
+                    list(req.tokens) + req.generated, k)[:k]
+                draft_lens[slot] = len(d)
+                drafts[slot, :len(d)] = np.asarray(d, np.int32)
+        return drafts, draft_lens
+
+    def _accept_spec(self, committed, counts, draft_lens) -> int:
+        """Commit each slot's verified token run through the one-token
+        acceptance path — EOS/budget/context checks run per TOKEN in
+        commit order, so deadline/evict/journey accounting counts
+        tokens, not steps. Tokens the engine committed after a terminal
+        state are discarded (the slot is released and its cache rows
+        evicted regardless). Publishes the per-step draft acceptance
+        aggregates and feeds the metrics hooks; returns the number of
+        tokens that actually entered streams."""
+        # caller holds self._lock (step())
+        appended = 0
+        acc_total = 0
+        rej_total = 0
+        tenant_tokens: Dict[Any, int] = {}
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            n = int(counts[slot])
+            proposed = int(draft_lens[slot])
+            accepted = max(n - 1, 0)   # committed minus the bonus token
+            acc_total += accepted
+            rej_total += max(proposed - accepted, 0)
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            if self.metrics is not None:
+                self.metrics.on_spec(req, proposed=proposed,
+                                     accepted=accepted)
+            took = 0
+            for tok in committed[slot][:n]:
+                took += 1
+                self._accept_token(req, int(tok))
+                if req.state != "running":
+                    break
+            appended += took
+            tenant_tokens[req.tenant] = \
+                tenant_tokens.get(req.tenant, 0) + took
+        if self.metrics is not None and tenant_tokens:
+            self.metrics.on_spec_step(tenant_tokens)
+        if acc_total:
+            publish_event("serve_spec_draft_accepted", tokens=acc_total,
+                          step=self.decode_steps)
+        if rej_total:
+            publish_event("serve_spec_draft_rejected", tokens=rej_total,
+                          step=self.decode_steps)
+        return appended
 
     def _close_trace(self, req: Request, marker: str, reason: str) -> None:
         """End a request's trace: close any still-open lifecycle spans at
@@ -825,12 +947,21 @@ class ServeScheduler:
                 if spike:
                     time.sleep(spike)  # a stalled device/host hiccup
                 self.injector.maybe_crash_decode(self.decode_steps)
-            next_tokens, _logits = self.engine.decode_step(
-                self.engine.last_tokens, active)
+            spec_k = self.engine.spec_draft_len
+            if spec_k and self.drafter is not None:
+                # speculative tick: host drafts -> ONE compiled verify
+                # step for every slot (the multi-token analog of
+                # decode_step — same trace under any churn)
+                drafts, draft_lens = self._build_drafts(spec_k)
+                committed, counts = self.engine.spec_decode_step(
+                    self.engine.last_tokens, drafts, draft_lens, active)
+            else:
+                next_tokens, _logits = self.engine.decode_step(
+                    self.engine.last_tokens, active)
             dt = time.perf_counter() - t0
             self.decode_steps += 1
             self.decode_step_s.append(dt)
-            self.decode_tokens += int(active.sum())
+            self.decode_slot_steps += int(active.sum())
             # second residency sample, AFTER the append: a completing
             # slot's final token is resident right now and gone before
             # the next tick's sample — without this the true peak is
@@ -851,9 +982,14 @@ class ServeScheduler:
                 self.memory.tick("serve_decode", step=self.decode_steps)
             publish_event("serve_decode_step", seconds=dt,
                           active=int(active.sum()))
-            for slot, req in enumerate(self.slots):
-                if req is not None:
-                    self._accept_token(req, int(next_tokens[slot]))
+            if spec_k and self.drafter is not None:
+                self.decode_tokens += self._accept_spec(
+                    committed, counts, draft_lens)
+            else:
+                self.decode_tokens += int(active.sum())
+                for slot, req in enumerate(self.slots):
+                    if req is not None:
+                        self._accept_token(req, int(next_tokens[slot]))
             self._flush_evictions()
             # AFTER the accept loop: completions landing on this tick
             # feed the SLO windows before this tick's evaluate() — a
@@ -889,6 +1025,9 @@ class ServeScheduler:
         self.journal.record({
             "decode_steps": self.decode_steps,
             "decode_tokens": self.decode_tokens,
+            "decode_slot_steps": self.decode_slot_steps,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
             "engine": self.engine.sampling_state(),
             # page accounting (None for slot engines): page tables +
             # refcounts, for the postmortem journal and the paged-recovery
@@ -1010,6 +1149,11 @@ class ServeScheduler:
             self.decode_steps = snap["decode_steps"]
             del self.decode_step_s[self.decode_steps:]
             self.decode_tokens = snap["decode_tokens"]
+            # spec counters ride the same snapshot (PR-18); .get keeps
+            # journals from pre-spec builds replayable
+            self.decode_slot_steps = snap.get("decode_slot_steps", 0)
+            self.spec_proposed = snap.get("spec_proposed", 0)
+            self.spec_accepted = snap.get("spec_accepted", 0)
             publish_event("serve_engine_restart", level="warning",
                           restarts=self.restarts,
                           resumed_slots=len(prefixes),
@@ -1118,4 +1262,7 @@ class ServeScheduler:
                           restarts=self.restarts,
                           admitted=self.admitted,
                           prefix_hits=self.prefix_hits,
-                          peak_resident_tokens=self.peak_resident_tokens)
+                          peak_resident_tokens=self.peak_resident_tokens,
+                          decode_slot_steps=self.decode_slot_steps,
+                          spec_proposed=self.spec_proposed,
+                          spec_accepted=self.spec_accepted)

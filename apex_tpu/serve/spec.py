@@ -1,0 +1,256 @@
+"""Speculative decoding: the host-side drafter and the ``DecodePolicy`` seam.
+
+Self-speculation needs no second model: the :class:`NGramDrafter` is a
+stdlib-only prompt-lookup drafter that proposes the next ``k`` tokens by
+matching the request's trailing n-gram against its own committed history
+(prompt + generated) and against a small corpus of recently observed
+prompts — the serving analog of prompt-lookup decoding. Drafts are cheap
+host guesses; correctness lives entirely in the engine's compiled verify
+step (``Engine.spec_decode_step``), which scores ``draft_len + 1``
+positions with the SAME single-token forward prefill/decode share.
+Acceptance is exact: a draft token is committed iff it equals the token
+the target policy itself produces at that position, so a greedy
+speculative stream is bit-identical to the one-token engine and a
+worthless drafter degrades throughput to the one-token path, never
+correctness (docs/serving.md "Speculative decoding and the decode-policy
+zoo").
+
+The :class:`DecodePolicy` seam names the sampling behavior per request:
+``greedy`` / ``top_p[=P]`` / ``min_p[=M]`` / ``spec(POLICY)``. With
+``EngineConfig(decode_policy=...)`` armed, per-slot temperature/top_p/
+min_p ride the compiled calls as DATA (``[num_slots]`` f32 arrays), so
+mixing policies in one batch never retraces — the one-compile invariant
+is indifferent to who wants nucleus sampling. :func:`sample_with_policy`
+is the branchless in-graph sampler: greedy rows are an exact
+``argmax`` selected by ``where(temperature <= 0)``, and at the default
+knobs (``top_p=1``, ``min_p=0``) the filter keeps every token, reducing
+to plain temperature sampling.
+
+Beam-like policies (``beam`` / ``beam_search`` / ``best_of``) are
+refused at parse time: they score whole sequences, so there is no
+per-token acceptance test the verify step could run — with speculation
+armed the refusal says so explicitly ("cannot be verified"), and both
+CLIs surface either refusal as exit 2 before any compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional, Sequence
+
+__all__ = ["DecodePolicy", "parse_policy", "NGramDrafter",
+           "sample_with_policy", "KNOWN_UNVERIFIABLE"]
+
+# beam-like policies keep a frontier of candidate SEQUENCES; acceptance
+# in the verify step is per-token, so there is nothing exact to verify a
+# draft against — refused at parse time, never half-supported
+KNOWN_UNVERIFIABLE = ("beam", "beam_search", "best_of")
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodePolicy:
+    """One request's sampling contract, as data.
+
+    ``temperature <= 0`` is exact greedy argmax (the oracle policy);
+    ``top_p`` keeps the smallest nucleus whose mass reaches P (rank 0 is
+    always kept); ``min_p`` keeps tokens with ``prob >= min_p * max
+    prob``. ``spec`` marks the ``spec(...)`` spelling — sugar that
+    demands the engine be built with ``spec_draft_len >= 1``.
+    """
+
+    kind: str
+    temperature: float = 1.0
+    top_p: float = 1.0
+    min_p: float = 0.0
+    spec: bool = False
+
+
+def _parse_value(kind: str, text: str, default: float) -> float:
+    if text == "":
+        return default
+    try:
+        return float(text)
+    except ValueError:
+        raise ValueError(
+            f"decode policy {kind!r}: bad parameter value {text!r}")
+
+
+def parse_policy(name: str, *, spec_draft_len: int = 0) -> DecodePolicy:
+    """Parse a ``--decode-policy`` spelling into a :class:`DecodePolicy`.
+
+    Grammar: ``greedy`` | ``top_p[=P]`` | ``min_p[=M]`` |
+    ``spec(POLICY)``, with an optional ``,t=T`` temperature suffix on the
+    sampled policies. Raises ``ValueError`` (CLIs map it to exit 2,
+    before params or compile) for unknown names, out-of-range knobs,
+    beam-like policies, and ``spec(...)`` without a draft length.
+    """
+    text = (name or "").strip()
+    if text.startswith("spec(") and text.endswith(")"):
+        inner = parse_policy(text[len("spec("):-1],
+                             spec_draft_len=spec_draft_len)
+        if inner.spec:
+            raise ValueError(
+                f"unknown decode policy {name!r}: spec(...) does not nest")
+        if spec_draft_len < 1:
+            raise ValueError(
+                "decode policy 'spec(...)' needs speculation armed: set "
+                "spec_draft_len >= 1 (--spec-draft-len)")
+        return dataclasses.replace(inner, spec=True)
+    base, _, tsuffix = text.partition(",")
+    base = base.strip()
+    kind, _, value = base.partition("=")
+    kind = kind.strip()
+    if kind in KNOWN_UNVERIFIABLE:
+        if spec_draft_len >= 1:
+            raise ValueError(
+                f"decode policy {kind!r} cannot be verified by the "
+                f"speculative acceptance oracle: beam-like policies "
+                f"score whole sequences, verification accepts per token")
+        raise ValueError(f"decode policy {kind!r} is not supported")
+    temperature = None
+    if tsuffix:
+        tkey, _, tval = tsuffix.strip().partition("=")
+        if tkey.strip() not in ("t", "temperature") or not tval:
+            raise ValueError(
+                f"unknown decode policy {name!r}: expected an optional "
+                f"',t=T' temperature suffix")
+        temperature = _parse_value(kind, tval.strip(), 1.0)
+        if temperature < 0:
+            raise ValueError(
+                f"decode policy {kind!r}: temperature {temperature} "
+                f"must be >= 0")
+    if kind == "greedy":
+        if value or temperature is not None:
+            raise ValueError(
+                "decode policy 'greedy' takes no parameters (it IS "
+                "temperature 0)")
+        return DecodePolicy("greedy", temperature=0.0)
+    if kind == "top_p":
+        p = _parse_value(kind, value.strip(), 0.9)
+        if not 0.0 < p <= 1.0:
+            raise ValueError(
+                f"decode policy 'top_p': p={p} must be in (0, 1]")
+        return DecodePolicy("top_p", top_p=p,
+                            temperature=1.0 if temperature is None
+                            else temperature)
+    if kind == "min_p":
+        m = _parse_value(kind, value.strip(), 0.05)
+        if not 0.0 <= m < 1.0:
+            raise ValueError(
+                f"decode policy 'min_p': m={m} must be in [0, 1)")
+        return DecodePolicy("min_p", min_p=m,
+                            temperature=1.0 if temperature is None
+                            else temperature)
+    raise ValueError(
+        f"unknown decode policy {name!r}: expected greedy | top_p[=P] | "
+        f"min_p[=M] | spec(POLICY)")
+
+
+class NGramDrafter:
+    """Prompt-lookup drafter: stdlib-only, deterministic, never on-device.
+
+    ``draft(history, k)`` proposes up to ``k`` next tokens by finding the
+    most recent earlier occurrence of the history's trailing n-gram
+    (``n = max_n .. 1``) and copying its continuation; each proposed
+    token is appended to the working history so a single match can
+    extend a whole draft. Two fallbacks keep proposals total: a corpus
+    of recently :meth:`observe`-d prompt streams (cross-request prompt
+    lookup — the host-side complement of the paged prefix index, which
+    shares K/V pages but stores no token ids), then repeat-last-token —
+    which exactly predicts the period-1 cycles greedy decode of small
+    models falls into, so even the smoke bench sees real acceptance.
+
+    A drafter is pure throughput: the verify step's exact acceptance
+    means a wrong guess costs one discarded cache row (rolled back by
+    length truncation), never a wrong token.
+    """
+
+    def __init__(self, max_n: int = 3, corpus_size: int = 32):
+        if max_n < 1:
+            raise ValueError(f"max_n={max_n} must be >= 1")
+        self.max_n = int(max_n)
+        self._corpus: Deque[List[int]] = deque(maxlen=int(corpus_size))
+
+    def observe(self, tokens: Sequence[int]) -> None:
+        """Feed a committed token stream (e.g. an admitted prompt) into
+        the cross-request lookup corpus."""
+        toks = [int(t) for t in tokens]
+        if toks:
+            self._corpus.append(toks)
+
+    @staticmethod
+    def _continuation(seq: List[int], pat: List[int],
+                      before: int) -> Optional[int]:
+        """The token following the most recent occurrence of ``pat``
+        ending strictly before index ``before`` in ``seq``."""
+        n = len(pat)
+        for i in range(min(before, len(seq)) - n, -1, -1):
+            if seq[i:i + n] == pat:
+                return seq[i + n] if i + n < len(seq) else None
+        return None
+
+    def _propose(self, hist: List[int]) -> int:
+        for n in range(min(self.max_n, len(hist) - 1), 0, -1):
+            pat = hist[-n:]
+            nxt = self._continuation(hist, pat, len(hist) - 1)
+            if nxt is not None:
+                return nxt
+        for n in range(min(self.max_n, len(hist)), 0, -1):
+            pat = hist[-n:]
+            for seq in reversed(self._corpus):
+                nxt = self._continuation(seq, pat, len(seq))
+                if nxt is not None:
+                    return nxt
+        return hist[-1] if hist else 0
+
+    def draft(self, history: Sequence[int], k: int) -> List[int]:
+        """Up to ``k`` proposed next tokens for ``history`` (prompt +
+        committed generations). Always returns exactly ``k`` tokens for
+        ``k >= 0`` and a non-empty history (the fallbacks are total)."""
+        hist = [int(t) for t in history]
+        out: List[int] = []
+        for _ in range(max(int(k), 0)):
+            nxt = self._propose(hist)
+            out.append(nxt)
+            hist.append(nxt)
+        return out
+
+
+def sample_with_policy(logits, rng, pol, *, top_k: int = 0):
+    """Branchless per-slot policy sampler (in-graph; policy knobs are
+    DATA). ``logits`` ``[B, V]``; ``pol`` a dict of ``[B]`` f32 arrays
+    ``temps`` / ``top_ps`` / ``min_ps``; ``top_k`` is the engine's
+    static config knob and applies on top. Greedy rows
+    (``temps <= 0``) return the exact fp32 argmax — bit-identical to
+    the legacy sampler — selected by ``where``, so one trace serves
+    every mixture of policies in the batch.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    temps = pol["temps"]
+    scaled = logits.astype(jnp.float32) / jnp.maximum(
+        temps[:, None], jnp.float32(1e-6))
+    if 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, jnp.float32(-1e30), scaled)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    # min_p: keep tokens at least min_p * the modal probability
+    keep = probs >= pol["min_ps"][:, None] * probs.max(-1, keepdims=True)
+    # top_p nucleus: sort descending, keep while the EXCLUSIVE prefix
+    # mass is still below p (rank 0 always survives: its exclusive
+    # cumsum is 0 < p)
+    order = jnp.argsort(-probs, axis=-1)
+    sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+    below = (jnp.cumsum(sorted_p, axis=-1) - sorted_p) \
+        < pol["top_ps"][:, None]
+    rows = jnp.arange(logits.shape[0])
+    keep &= jnp.zeros(probs.shape, bool).at[rows[:, None], order].set(below)
+    # the argmax row is unconditionally kept: an fp edge (all mass in
+    # masked tokens) must never leave an empty support
+    amax = jnp.argmax(logits, axis=-1)
+    keep = keep.at[rows, amax].set(True)
+    masked = jnp.where(keep, scaled, jnp.float32(-1e30))
+    sampled = jax.random.categorical(rng, masked, axis=-1)
+    return jnp.where(temps <= 0.0, amax, sampled).astype(jnp.int32)

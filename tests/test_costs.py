@@ -113,6 +113,29 @@ def test_walk_multiplies_while_bodies_by_trip_count():
     assert "notes" not in ten     # trip count statically resolved
 
 
+def test_walk_ignores_phase_named_source_paths(tmp_path):
+    """MLIR loc bodies quote source FILE paths alongside named_scope
+    paths — code traced from a directory that happens to be named after
+    a phase (here ``verify/``) must not have its ops claimed by that
+    phase."""
+    import importlib.util
+
+    mod_dir = tmp_path / "verify"
+    mod_dir.mkdir()
+    src = mod_dir / "user_drive.py"
+    src.write_text("import jax.numpy as jnp\n\n\n"
+                   "def f(x):\n"
+                   "    return jnp.tanh(x) @ x\n")
+    spec = importlib.util.spec_from_file_location("_phase_path_mod", src)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rec = costs.walk_module(costs.stablehlo_debug_text(
+        jax.jit(mod.f).lower(jnp.ones((4, 4), jnp.float32))))
+    _assert_reconciles(rec)
+    assert rec["phases"]["verify"]["ops"] == 0
+    assert rec["phases"]["other"]["ops"] > 0
+
+
 def test_expected_collective_ops_contract_and_unknown_mode():
     # the PR-15 contract, spelled once (serve/tp.py delegates here)
     assert costs.expected_collective_ops(12, "exact") \
@@ -208,6 +231,43 @@ def test_cost_ledger_survives_reset_without_relowering(params):
     assert json.dumps(before, sort_keys=True) \
         == json.dumps(after, sort_keys=True)
     assert "prefill_8" in after["executables"]
+
+
+def test_cost_ledger_spec_verify_entry(params):
+    """PR-18 ride-along: a spec-armed engine's ledger carries the
+    verify executable from the SAME retained lowerings (no re-trace,
+    works after reset), its verify phase is populated via the model's
+    final_scope threading, and the spec workload axes make spec-off
+    ledgers refuse rather than compare."""
+    eng = Engine(CFG, params,
+                 EngineConfig(num_slots=3, max_len=32, temperature=0.0,
+                              block_k=8, spec_draft_len=2), seed=0)
+    led = eng.cost_ledger(prompt_buckets=[8])
+    assert eng.decode_traces == 1 and eng.verify_traces == 1
+    assert set(led["executables"]) == {"decode", "prefill_8", "verify"}
+    ver = led["executables"]["verify"]
+    _assert_reconciles(ver)
+    # the verify phase holds the final LN + logits work of all K+1
+    # scanned positions (final_scope="verify"); the inner phases and the
+    # acceptance sampler keep their own attribution
+    for ph in ("ln_qkv", "attention", "mlp", "sampling", "verify"):
+        assert ver["phases"][ph]["ops"] > 0, ph
+    # decode/prefill entries keep "verify" EMPTY: their final scope is
+    # still "sampling", so the new phase never leaks attribution
+    assert led["executables"]["decode"]["phases"]["verify"]["ops"] == 0
+    assert led["workload"]["spec_draft_len"] == 2
+    # byte-deterministic across reset, still no re-trace (warm restart)
+    eng.reset()
+    led2 = eng.cost_ledger(prompt_buckets=[8])
+    assert eng.decode_traces == 1 and eng.verify_traces == 1
+    assert json.dumps(led, sort_keys=True) \
+        == json.dumps(led2, sort_keys=True)
+    # spec on/off is an incomparable ledger axis (missing key = off)
+    plain = _engine(params).cost_ledger()
+    assert any("spec_draft_len" in r
+               for r in costs.provenance_mismatch(led, plain))
+    assert "spec_draft_len" not in plain["workload"] \
+        or plain["workload"]["spec_draft_len"] == 0
 
 
 # --------------------------------------------- 3. the gate + diff tools

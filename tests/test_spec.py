@@ -1,0 +1,411 @@
+"""Speculative decoding (PR 18) — the exact acceptance oracle.
+
+Layers under test:
+
+1. **The seam** — ``parse_policy`` grammar incl. the beam-like refusal,
+   the ``NGramDrafter``'s lookup order + total fallbacks, and
+   ``sample_with_policy`` reducing to the legacy sampler at default
+   knobs.
+2. **Exactness** — greedy speculative streams are bit-identical to the
+   one-token engine for ``draft_len ∈ {1, 2, 4}`` on the slot AND paged
+   engines AND at tp=2 exact; a pathological drafter (0% acceptance)
+   degrades throughput to exactly the one-token floor, never
+   correctness.
+3. **One-compile invariant** — a spec-armed scheduler churned through
+   admit/evict/abort/prefix-hit keeps ``verify_traces == 1`` and
+   ``decode_traces`` flat (the verify step IS the decode step when
+   speculation is armed).
+4. **The gate + CLI matrix** — check_regression treats the new families
+   higher-is-better and REFUSES cross-config comparisons on the spec
+   workload axes; both CLIs refuse inert/unverifiable spec flags before
+   any compile.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt2 import GPT2Config
+from apex_tpu.serve.engine import Engine, EngineConfig, init_gpt2_params
+from apex_tpu.serve.scheduler import Request, ServeScheduler
+from apex_tpu.serve.spec import (KNOWN_UNVERIFIABLE, DecodePolicy,
+                                 NGramDrafter, parse_policy,
+                                 sample_with_policy)
+
+pytestmark = pytest.mark.serve
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# n_head=4 so the same params serve the tp=2 exactness leg
+CFG = GPT2Config(vocab_size=97, n_positions=64, n_embd=32, n_layer=2,
+                 n_head=4, compute_dtype=jnp.float32)
+
+PROMPTS = [[5, 6, 7, 5, 6, 7, 5], [11, 12, 13, 11, 12], [3, 4],
+           [20, 21, 22, 23, 20, 21]]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_gpt2_params(CFG, seed=0)
+
+
+def _engine(params, **kw):
+    kw.setdefault("num_slots", 4)
+    kw.setdefault("max_len", 48)
+    kw.setdefault("temperature", 0.0)
+    return Engine(CFG, params, EngineConfig(**kw), seed=0)
+
+
+def _serve(params, prompts=PROMPTS, drafter=None, **kw):
+    eng = _engine(params, **kw)
+    sched = ServeScheduler(eng, drafter=drafter)
+    for i, p in enumerate(prompts):
+        sched.submit(Request(request_id=f"r{i}", tokens=list(p),
+                             max_new_tokens=12))
+    stats = sched.run()
+    streams = {r["request_id"]: r["generated"] for r in stats.requests}
+    return streams, stats, eng
+
+
+# ------------------------------------------------------- 1. the seam
+
+def test_parse_policy_grammar():
+    assert parse_policy("greedy") == DecodePolicy("greedy",
+                                                  temperature=0.0)
+    assert parse_policy("top_p") == DecodePolicy("top_p", top_p=0.9)
+    assert parse_policy("top_p=0.5,t=0.7") \
+        == DecodePolicy("top_p", top_p=0.5, temperature=0.7)
+    assert parse_policy("min_p") == DecodePolicy("min_p", min_p=0.05)
+    assert parse_policy("min_p=0.2") \
+        == DecodePolicy("min_p", min_p=0.2)
+    sp = parse_policy("spec(top_p=0.8)", spec_draft_len=2)
+    assert sp.spec and sp.top_p == 0.8
+
+    with pytest.raises(ValueError, match="unknown decode policy"):
+        parse_policy("nucleus")
+    with pytest.raises(ValueError, match="takes no parameters"):
+        parse_policy("greedy,t=0.5")
+    with pytest.raises(ValueError, match=r"in \(0, 1\]"):
+        parse_policy("top_p=0")
+    with pytest.raises(ValueError, match=r"in \[0, 1\)"):
+        parse_policy("min_p=1.0")
+    with pytest.raises(ValueError, match="needs speculation armed"):
+        parse_policy("spec(greedy)")
+    with pytest.raises(ValueError, match="does not nest"):
+        parse_policy("spec(spec(greedy))", spec_draft_len=2)
+    # beam-like: refused either way, with the oracle-specific message
+    # exactly when speculation would have to verify it
+    for name in KNOWN_UNVERIFIABLE:
+        with pytest.raises(ValueError, match="is not supported"):
+            parse_policy(name)
+        with pytest.raises(ValueError, match="cannot be verified"):
+            parse_policy(name, spec_draft_len=1)
+
+
+def test_ngram_drafter_lookup_and_fallbacks():
+    d = NGramDrafter(max_n=3)
+    # trailing bigram [1, 2] recurs: its continuation 3 is the proposal,
+    # and the extended working history keeps the copy going
+    assert d.draft([1, 2, 3, 4, 1, 2], 3) == [3, 4, 1]
+    # no self-match -> corpus lookup
+    d.observe([7, 8, 9, 7, 8])
+    assert d.draft([8, 9], 1) == [7]
+    # nothing anywhere -> repeat-last-token (total, deterministic)
+    fresh = NGramDrafter()
+    assert fresh.draft([42], 3) == [42, 42, 42]
+    assert fresh.draft([1, 2, 3], 2) == fresh.draft([1, 2, 3], 2)
+    assert fresh.draft([5], 0) == []
+
+
+def test_sample_with_policy_defaults_reduce_to_legacy():
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(jax.random.PRNGKey(1), (4, 23)) * 3.0
+    # greedy rows (temps <= 0): exact argmax, bit-identical to legacy
+    pol = {"temps": jnp.zeros(4), "top_ps": jnp.ones(4),
+           "min_ps": jnp.zeros(4)}
+    out = sample_with_policy(logits, rng, pol)
+    assert (np.asarray(out)
+            == np.asarray(jnp.argmax(logits, axis=-1))).all()
+    # default knobs at t=1: the keep mask is all-true, so the draw IS
+    # plain temperature sampling on the same key
+    pol = {"temps": jnp.ones(4), "top_ps": jnp.ones(4),
+           "min_ps": jnp.zeros(4)}
+    out = sample_with_policy(logits, rng, pol)
+    plain = jax.random.categorical(rng, logits.astype(jnp.float32),
+                                   axis=-1)
+    assert (np.asarray(out) == np.asarray(plain)).all()
+    # top_p never empties the support: p -> 0 collapses to argmax
+    pol = {"temps": jnp.ones(4), "top_ps": jnp.full(4, 1e-9),
+           "min_ps": jnp.zeros(4)}
+    out = sample_with_policy(logits, rng, pol)
+    assert (np.asarray(out)
+            == np.asarray(jnp.argmax(logits, axis=-1))).all()
+
+
+def test_policy_mixing_in_one_batch_single_trace(params):
+    """Per-request policies are DATA: mixing greedy and top_p rows in
+    one batch rides one decode trace, and the greedy rows match the
+    policy-off oracle stream bit for bit."""
+    base, _, _ = _serve(params)
+    eng = _engine(params, decode_policy="greedy")
+    sched = ServeScheduler(eng)
+    for i, p in enumerate(PROMPTS):
+        sched.submit(Request(
+            request_id=f"r{i}", tokens=list(p), max_new_tokens=12,
+            policy="top_p=0.9" if i % 2 else "greedy"))
+    stats = sched.run()
+    assert eng.decode_traces == 1
+    streams = {r["request_id"]: r["generated"] for r in stats.requests}
+    for i in (0, 2):          # the greedy rows are the oracle's
+        assert streams[f"r{i}"] == base[f"r{i}"]
+
+
+# ------------------------------------------------------ 2. exactness
+
+@pytest.mark.parametrize("draft_len", [1, 2, 4])
+def test_greedy_spec_bit_identical_slot_and_paged(params, draft_len):
+    base, base_stats, _ = _serve(params)
+    assert base_stats.summary()["accepted_tokens_per_step"] == 1.0
+
+    streams, stats, eng = _serve(params, spec_draft_len=draft_len)
+    assert streams == base
+    assert eng.verify_traces == 1
+    assert eng.decode_traces == 0     # every tick ran the verify step
+    s = stats.summary()
+    assert s["accepted_tokens_per_step"] >= 1.0
+    assert stats.decode_tokens == base_stats.decode_tokens
+    # multi-token commits finish in fewer steps, never more
+    assert stats.decode_steps <= base_stats.decode_steps
+
+    paged, pstats, peng = _serve(params, spec_draft_len=draft_len,
+                                 page_size=8, num_pages=32)
+    assert paged == base
+    assert peng.verify_traces == 1 and peng.decode_traces == 0
+    if draft_len >= 2:
+        # the periodic prompts make the n-gram drafter actually land
+        assert pstats.summary()["accepted_tokens_per_step"] > 1.0
+
+
+def test_greedy_spec_bit_identical_tp2_exact(params, tp_devices):
+    base, _, _ = _serve(params)
+    streams, _, eng = _serve(params, spec_draft_len=2, tp=2)
+    assert streams == base            # sharded verify == one-chip oracle
+    assert eng.verify_traces == 1 and eng.decode_traces == 0
+
+
+class _WrongDrafter:
+    """Pathological drafter: proposes (oracle_token + 1) mod vocab at
+    every position, so the exact acceptance test rejects EVERY draft —
+    the worst case speculation must survive with zero correctness
+    loss."""
+
+    def __init__(self, oracle_streams):
+        self._by_prompt = {tuple(PROMPTS[i]): oracle_streams[f"r{i}"]
+                          for i in range(len(PROMPTS))}
+
+    def draft(self, history, k):
+        hist = [int(t) for t in history]
+        for prompt, gen in self._by_prompt.items():
+            if tuple(hist[:len(prompt)]) == prompt:
+                done = len(hist) - len(prompt)
+                return [(gen[done + j] + 1) % CFG.vocab_size
+                        if done + j < len(gen) else 0
+                        for j in range(k)]
+        return [0] * k
+
+
+def test_pathological_drafter_floors_at_one_token(params):
+    base, base_stats, _ = _serve(params)
+    streams, stats, eng = _serve(params, spec_draft_len=2,
+                                 drafter=_WrongDrafter(base))
+    assert streams == base            # zero correctness loss
+    s = stats.summary()
+    assert s["spec_accept_rate"] == 0.0
+    # every verify step committed exactly its one bonus token: the
+    # throughput floor IS the one-token engine's
+    assert s["accepted_tokens_per_step"] == 1.0
+    assert stats.decode_steps == base_stats.decode_steps
+    assert eng.verify_traces == 1
+
+
+# ----------------------------------------- 3. one-compile under churn
+
+def test_spec_traces_flat_under_churn(params):
+    """Admit/evict/abort/prefix-hit churn through a spec-armed paged
+    engine: one verify trace, one prefill trace per bucket, zero decode
+    traces — the invariant the whole PR rides on."""
+    eng = _engine(params, num_slots=2, spec_draft_len=2, page_size=8,
+                  num_pages=48, prefix_cache=True)
+    sched = ServeScheduler(eng)
+    shared = [9, 8, 7, 6, 5, 4, 3, 2]        # one full shared page
+    # wave 1: overcommit the two slots (queueing + backfill churn)
+    for i in range(4):
+        sched.submit(Request(request_id=f"a{i}",
+                             tokens=shared + [30 + i],
+                             max_new_tokens=6))
+    sched.submit(Request(request_id="doomed", tokens=[1, 2, 3],
+                         max_new_tokens=6))
+    while sched.step():
+        if sched.decode_steps == 2:
+            sched.abort("doomed")            # mid-stream/queued abort
+    hits_before = sched.prefix_hits
+    # wave 2: same shared prefix -> prefix-hit admissions re-enter the
+    # SAME verify executable
+    for i in range(2):
+        sched.submit(Request(request_id=f"b{i}",
+                             tokens=shared + [60 + i],
+                             max_new_tokens=4))
+    stats = sched.run()
+    assert sched.prefix_hits > hits_before
+    assert eng.verify_traces == 1
+    assert eng.decode_traces == 0
+    done = {r["request_id"]: r["state"] for r in stats.requests}
+    assert done["doomed"] == "evicted"
+    assert all(done[f"b{i}"] == "completed" for i in range(2))
+    # token accounting counts tokens, not steps
+    assert stats.decode_tokens >= stats.decode_slot_steps > 0
+
+
+def test_spec_journal_recover_restores_counters(params):
+    """Warm restart (PR-14) carries the spec counters: the recovered
+    scheduler's accounting continues from the snapshot, not from
+    zero."""
+    from apex_tpu.serve.resilience import TickJournal
+
+    eng = _engine(params, num_slots=2, spec_draft_len=2)
+    sched = ServeScheduler(eng, journal=TickJournal())
+    for i in range(2):
+        sched.submit(Request(request_id=f"r{i}",
+                             tokens=list(PROMPTS[i]),
+                             max_new_tokens=8))
+    for _ in range(3):
+        sched.step()
+    want = (sched.decode_slot_steps, sched.spec_proposed,
+            sched.spec_accepted)
+    assert want[0] > 0
+    sched.decode_slot_steps = sched.spec_proposed = 0
+    sched.spec_accepted = 0                  # simulate torn-tick loss
+    sched.recover(error="injected")
+    assert (sched.decode_slot_steps, sched.spec_proposed,
+            sched.spec_accepted) == want
+    sched.run()
+
+
+def test_spec_engine_validation(params):
+    with pytest.raises(ValueError, match="spec_draft_len"):
+        _engine(params, spec_draft_len=-1)
+    with pytest.raises(ValueError, match="max_len"):
+        _engine(params, spec_draft_len=48, max_len=48)
+    eng = _engine(params, num_slots=2)
+    with pytest.raises(ValueError, match="spec_decode_step needs"):
+        eng.spec_decode_step(np.zeros(2, np.int32),
+                             np.zeros((2, 1), np.int32),
+                             np.zeros(2, np.int32),
+                             np.zeros(2, bool))
+
+
+# --------------------------------------------- 4. the gate + CLI matrix
+
+def _check_regression():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import check_regression
+    finally:
+        sys.path.pop(0)
+    return check_regression
+
+
+def _suite_doc(atps, rate, tps, workload):
+    return {"serve_decode": {
+        "metric": "serve_decode_tokens_per_s", "value": tps,
+        "unit": "tokens_per_s", "accepted_tokens_per_step": atps,
+        "spec_accept_rate": rate, "spec_tokens_per_s": tps,
+        "workload": dict(workload)}}
+
+
+def test_gate_directions_and_spec_axes(tmp_path):
+    cr = _check_regression()
+    for name in ("serve_decode.accepted_tokens_per_step",
+                 "serve_decode.spec_accept_rate",
+                 "serve_decode.spec_tokens_per_s"):
+        assert not cr.lower_is_better(name), name
+
+    spec_wl = {"spec": True, "draft_len": 2, "decode_policy": None}
+    # legacy baselines carry NO spec keys: missing = speculation off,
+    # and the gate must REFUSE, not compare
+    legacy = _suite_doc(1.0, 0.0, 300.0, {})
+    cur = _suite_doc(1.9, 0.5, 500.0, spec_wl)
+    bad = cr.incomparable_entries(cur, legacy)
+    assert "spec" in bad.get("serve_decode", "")
+    # differing widths refuse too; identical spec configs compare
+    assert cr.incomparable_entries(
+        cur, _suite_doc(1.5, 0.3, 400.0,
+                        {**spec_wl, "draft_len": 4}))
+    assert cr.incomparable_entries(cur, _suite_doc(
+        1.5, 0.3, 400.0, spec_wl)) == {}
+
+    # a REAL gate run (PR-15 precedent): same config, worse acceptance
+    # -> exit 1; legacy baseline -> exit 2 (nothing comparable)
+    cur_p = str(tmp_path / "cur.json")
+    json.dump(cur, open(cur_p, "w"))
+    same = str(tmp_path / "same.json")
+    json.dump(cur, open(same, "w"))
+    assert cr.main([cur_p, "--suite", same,
+                    "--kernels", "serve_decode"]) == 0
+    worse = str(tmp_path / "worse.json")
+    json.dump(_suite_doc(1.9, 0.5, 500.0, spec_wl), open(cur_p, "w"))
+    json.dump(_suite_doc(2.5, 0.8, 500.0, spec_wl), open(worse, "w"))
+    assert cr.main([cur_p, "--suite", worse,
+                    "--kernels", "serve_decode"]) == 1
+    legacy_p = str(tmp_path / "legacy.json")
+    json.dump(legacy, open(legacy_p, "w"))
+    assert cr.main([cur_p, "--suite", legacy_p,
+                    "--kernels", "serve_decode"]) == 2
+
+
+def test_serve_cli_spec_flag_matrix(capsys):
+    """Inert or unverifiable spec flags are loud exit-2 usage errors
+    BEFORE any params or compile work (PR-10 precedent) — in-process:
+    the validation runs in milliseconds, a subprocess would only pay a
+    jax import to reach the same lines."""
+    from apex_tpu.serve.cli import main
+
+    for argv, msg in [
+            (["--spec-draft-len", "0"], "must be >= 1"),
+            (["--spec-draft-len", "-3"], "must be >= 1"),
+            (["--decode-policy", "nucleus"], "unknown decode policy"),
+            (["--decode-policy", "beam"], "is not supported"),
+            (["--spec-draft-len", "2", "--decode-policy", "beam"],
+             "cannot be verified"),
+            (["--decode-policy", "spec(greedy)"],
+             "needs speculation armed"),
+    ]:
+        assert main(argv) == 2, argv
+        assert msg in capsys.readouterr().err, argv
+
+
+def test_bench_cli_spec_flag_matrix():
+    from apex_tpu.bench_cli import _serve_bench
+
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        _serve_bench(steps=1, spec_draft_len=0)
+    with pytest.raises(SystemExit, match="is not supported"):
+        _serve_bench(steps=1, decode_policy="best_of")
+    with pytest.raises(SystemExit, match="cannot be verified"):
+        _serve_bench(steps=1, spec_draft_len=2, decode_policy="beam")
+    with pytest.raises(SystemExit, match="unknown decode policy"):
+        _serve_bench(steps=1, decode_policy="banana")
+    # --spec-draft-len outside --serve mode falls in the serve-only
+    # refusal (subprocess: the matrix lives in main's argv routing)
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.bench_cli",
+         "--spec-draft-len", "2"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 2
+    assert "needs --serve" in r.stderr

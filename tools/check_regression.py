@@ -116,7 +116,14 @@ _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
                  # cost-ledger roofline bound (PR 17): a predicted-MFU
                  # drop means the step moved toward memory-bound — worse
                  # ("mfu" already matches, listed for the explicit record)
-                 "predicted_mfu")
+                 "predicted_mfu",
+                 # speculative decoding (PR 18): tokens committed per
+                 # verify step and the draft acceptance fraction — both
+                 # collapse to the one-token floor when speculation stops
+                 # paying, so a drop is a strict regression
+                 # ("tokens"/"_per_s" already match the throughput names;
+                 # listed for the explicit record)
+                 "accepted_tokens_per_step", "accept_rate")
 # failure fractions beat the generic "_frac" higher family (the mirror
 # of the hit_rate-vs-_rate precedent): a snapshot's shed_frac or
 # deadline_miss_frac going UP is strictly worse — without the override
@@ -423,9 +430,17 @@ def check_device_kinds(current_path: str, baseline_path: str,
 # decode-replica capacity on migrated pages and routes prefill work to
 # dedicated replicas — its latency/throughput must never gate against a
 # unified capture (roles None = unified; old captures predate the axis).
+# Speculative decoding (PR 18) is a fourth: a spec capture commits
+# multi-token verify steps — its tokens/s rides acceptance luck and its
+# step time carries draft_len + 1 positions of compute, so neither
+# direction compares against a one-token capture (or across draft
+# widths / decode policies). Missing keys = speculation off / legacy
+# greedy, the pre-PR-18 default.
 INCOMPARABLE_WORKLOAD_KEYS = {"tp": 1, "tp_sync": None,
                               "disagg": False, "roles": None,
-                              "diurnal": False}
+                              "diurnal": False,
+                              "spec": False, "draft_len": 0,
+                              "decode_policy": None}
 
 
 def incomparable_entries(cur_doc: dict, base_doc: dict) -> Dict[str, str]:
@@ -605,8 +620,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for name, reason in sorted(
                 incomparable_entries(cur_doc, base_doc).items()):
             print(f"INCOMPARABLE [{name}] {reason} — refusing to gate "
-                  f"this entry (different mesh shapes measure different "
-                  f"steps)")
+                  f"this entry (the two captures measure different "
+                  f"serving pipelines)")
             current = {k: v for k, v in current.items()
                        if k != name and k.split(".", 1)[0] != name}
             baseline = {k: v for k, v in baseline.items()
