@@ -243,7 +243,8 @@ def _telemetry_bench(jsonl_path: "str | None", steps: int = 8,
 
 def _train_chaos_bench(steps: int = 12, world: int = 1,
                        grad_shards: "int | None" = None,
-                       emit_baseline: "str | None" = None) -> None:
+                       emit_baseline: "str | None" = None,
+                       tp: int = 1) -> None:
     """Trainer chaos smoke (``--train-chaos``): run the production
     trainer under its supervisor through a seeded crash + mid-save-crash
     + preemption/relaunch schedule, and emit a suite-shaped
@@ -266,7 +267,7 @@ def _train_chaos_bench(steps: int = 12, world: int = 1,
     g = grad_shards if grad_shards is not None else max(1, world)
     steps = max(6, int(steps))
     config = TrainConfig(steps=steps, batch=8, seq=16, world=world,
-                         grad_shards=g, seed=0)
+                         grad_shards=g, seed=0, tp=tp)
     with tempfile.TemporaryDirectory() as ckpt_dir:
         import dataclasses
 
@@ -302,10 +303,22 @@ def _train_chaos_bench(steps: int = 12, world: int = 1,
             # the gate via the "recompile" hint; the contract is exactly
             # one trace — >1 means a restart recompiled)
             "step_recompiles": counts["shard_grads"],
+            # storage-health counters off the goodput ledger: a healthy
+            # run holds both at 0, so a bit-rot quarantine storm or
+            # unexpected reshard churn on restore gates as a regression
+            "ckpt_quarantined": report["goodput"]["events"].get(
+                "train_ckpt_quarantined", 0),
+            "topology_restored": report["goodput"]["events"].get(
+                "train_topology_restored", 0),
             "bench_wall_s": round(wall, 3),
             "workload": {"steps": steps, "batch": config.batch,
                          "seq": config.seq,
                          "world": world, "grad_shards": g,
+                         # tensor-axis provenance: a dp×tp capture is
+                         # incomparable with a legacy dp-only baseline
+                         # (missing key reads as tp=1), so the gate
+                         # refuses instead of pretending to compare
+                         "tp": tp,
                          "amp_dtype": config.amp,
                          "save_every": config.save_every,
                          "max_restarts": 3},
@@ -1137,6 +1150,11 @@ def main() -> None:
             ap.add_argument("--grad-shards", type=int, default=None,
                             help="fixed micro-shard count (default: "
                                  "world)")
+            ap.add_argument("--tp", type=int, default=1,
+                            help="tensor-parallel degree: each micro-"
+                                 "shard's grad runs over the head-axis "
+                                 "mesh (bit-identical to --tp 1); "
+                                 "stamped into workload provenance")
             ap.add_argument("--emit-baseline", nargs="?",
                             const="BENCH_BASELINE_TRAIN.json",
                             default=None,
@@ -1155,8 +1173,15 @@ def main() -> None:
                       f"{shards}), and --grad-shards dividing the "
                       f"bench batch of 8", file=sys.stderr)
                 sys.exit(2)
+            if args.tp < 1 or 32 % args.tp:
+                # the bench model's hidden is the TrainConfig default
+                # (32); same loud pre-compile refusal as the trainer CLI
+                print(f"apex-tpu-bench: --train-chaos --tp {args.tp} "
+                      f"must be >= 1 and divide the bench model's "
+                      f"hidden of 32", file=sys.stderr)
+                sys.exit(2)
             _train_chaos_bench(args.steps, args.world, args.grad_shards,
-                               args.emit_baseline)
+                               args.emit_baseline, tp=args.tp)
         elif has_serve:
             import argparse
 

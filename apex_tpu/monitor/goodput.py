@@ -129,6 +129,14 @@ COUNTED_EVENTS = (
     # was saved under (the elastic-resize signal), and each committed
     # checkpoint (rank 0 publishes once per commit/resize/restart)
     "train_restart", "train_elastic_resized", "train_checkpoint_commit",
+    # topology-portable checkpoints (resilience.topology): a restore
+    # crossed a tensor-parallel topology boundary (the manifest's layout
+    # block named a different tp than the restoring config — reassembled
+    # and re-placed automatically, counted so the crossing is never
+    # silent), and a committed checkpoint quarantined during the
+    # trainer's restore walk (storage rot caught by crc32/blake2b — a
+    # quarantine storm gates as a regression via check_regression)
+    "train_topology_restored", "train_ckpt_quarantined",
     # disaggregated serving (apex_tpu.serve.disagg): one migrated KV
     # page landed certified in a decode pool; one handoff refused on
     # arrival (chain-hash / payload-digest mismatch — the request fell

@@ -36,6 +36,7 @@ enforces that no checkpoint-writing code bypasses the ``.tmp`` +
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -165,6 +166,12 @@ class CheckpointManager:
         self.fs = fs or LOCAL_FS
         self._sleep = sleep
         self.quarantine_corrupt = quarantine_corrupt
+        # restore observability (read by the trainer after restore_latest):
+        # the layout block of the manifest that actually restored, and the
+        # steps quarantined while walking to it
+        self.last_restored_layout: Optional[Dict[str, Any]] = None
+        self.last_quarantined: List[Dict[str, Any]] = []
+        self._last_manifest: Optional[Dict[str, Any]] = None
         self.fs.makedirs(self.directory)
 
     def _is_rank0(self) -> bool:
@@ -192,8 +199,15 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     # ---- save -----------------------------------------------------------
-    def save(self, step: int, tree: Any) -> str:
+    def save(self, step: int, tree: Any, *,
+             layout: Optional[Dict[str, Any]] = None) -> str:
         """Write checkpoint ``step`` atomically; returns the committed path.
+
+        ``layout`` (optional) is the writer's topology block
+        (:func:`apex_tpu.resilience.topology.layout_block`) — stamped into
+        the manifest under ``"layout"`` with ``"storage": "dense"`` so a
+        restore onto a different topology is observable. Omitted, the
+        manifest stays byte-compatible with pre-layout checkpoints.
 
         Transient ``OSError`` retries up to ``retries`` times with
         exponential backoff (each attempt restages from scratch). Any other
@@ -229,6 +243,11 @@ class CheckpointManager:
                                              np.asarray(leaf).dtype)),
                         "nbytes": len(blob),
                         "crc32": zlib.crc32(blob),
+                        # blake2b of the BLOB bytes (crc32's cryptographic
+                        # twin): the jax-free tools/ckpt_inspect.py verifies
+                        # leaves against this without parsing npy
+                        "blake2b": hashlib.blake2b(
+                            blob, digest_size=16).hexdigest(),
                     }
                     self.fs.write_bytes(os.path.join(tmp, entry["file"]),
                                         blob)
@@ -240,6 +259,9 @@ class CheckpointManager:
                     "num_leaves": len(leaves),
                     "leaves": entries,
                 }
+                if layout is not None:
+                    manifest["layout"] = {"storage": "dense",
+                                          **dict(layout)}
                 # manifest last: its presence marks a fully staged set
                 self.fs.write_bytes(os.path.join(tmp, MANIFEST_NAME),
                                     json.dumps(manifest, indent=1).encode())
@@ -314,6 +336,8 @@ class CheckpointManager:
             structured_warning("checkpoint_quarantine_failed",
                                step=int(step), reason=str(e))
             return
+        self.last_quarantined.append({"step": int(step), "path": dst,
+                                      "reason": reason})
         structured_warning("checkpoint_quarantined", step=int(step),
                            path=dst, reason=reason)
 
@@ -340,10 +364,15 @@ class CheckpointManager:
                 f"{mpath}: bad header (version="
                 f"{manifest.get('format_version')}, "
                 f"step={manifest.get('step')}, expected {step})")
-        if manifest.get("layout") is not None:
+        layout = manifest.get("layout")
+        if layout is not None and not (isinstance(layout, dict)
+                                       and layout.get("storage")
+                                       == "dense"):
             # a sharded (or future-layout) step: not corrupt, but this
             # manager cannot assemble it — fail validation cleanly rather
-            # than KeyError mid-restore
+            # than KeyError mid-restore. A dict with storage="dense" is
+            # this manager's own topology stamp; anything else belongs to
+            # another manager.
             raise CheckpointLayoutError(
                 f"{mpath}: layout {manifest['layout']!r} requires the "
                 f"matching manager (ShardedCheckpointManager)")
@@ -360,8 +389,14 @@ class CheckpointManager:
                     zlib.crc32(data) != entry["crc32"]:
                 raise CheckpointCorruptError(
                     f"{fpath}: checksum mismatch (torn or corrupt write)")
+            if "blake2b" in entry and hashlib.blake2b(
+                    data, digest_size=16).hexdigest() != entry["blake2b"]:
+                raise CheckpointCorruptError(
+                    f"{fpath}: blake2b digest mismatch (crc collision or "
+                    f"manifest tamper)")
             if _blobs is not None:
                 _blobs[entry["file"]] = data
+        self._last_manifest = manifest
         return manifest
 
     def restore(self, step: int, like: Any) -> Any:
@@ -392,9 +427,15 @@ class CheckpointManager:
         or ``None`` when no valid checkpoint exists.
         """
         t_start = time.perf_counter()
+        self.last_restored_layout = None
+        self.last_quarantined = []
         for step in reversed(self.all_steps()):
             try:
                 out = step, self.restore(step, like)
+                layout = (self._last_manifest or {}).get("layout")
+                self.last_restored_layout = (dict(layout)
+                                             if isinstance(layout, dict)
+                                             else None)
                 publish_event(
                     "checkpoint_restore_stall", step=int(step),
                     seconds=round(time.perf_counter() - t_start, 6))

@@ -37,6 +37,7 @@ runs — the same requirement every sharded-checkpoint system has.
 
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 import os
@@ -605,7 +606,13 @@ class ShardedCheckpointManager(CheckpointManager):
                 == self.coordinator.process_index)
 
     # ---- save -----------------------------------------------------------
-    def save(self, step: int, tree: Any) -> str:
+    def save(self, step: int, tree: Any, *,
+             layout: Optional[Dict[str, Any]] = None) -> str:
+        """Commit ``step`` via the 5-phase protocol below; ``layout``
+        (optional) is the writer's topology block, stamped into the
+        global manifest as ``{"storage": "sharded", **layout}`` — without
+        it the manifest keeps the legacy ``"sharded"`` string, so
+        pre-topology checkpoints and their readers are untouched."""
         t_start = time.perf_counter()
         rank = self.coordinator.process_index
         world = self.coordinator.process_count
@@ -637,7 +644,8 @@ class ShardedCheckpointManager(CheckpointManager):
         publish_err: Optional[Exception] = None
         if rank == 0:
             try:
-                self._publish(step, tmp, final, specs, world)
+                self._publish(step, tmp, final, specs, world,
+                              layout=layout)
             except (OSError, CheckpointError) as e:
                 structured_warning("checkpoint_publish_failed",
                                    step=int(step), reason=str(e))
@@ -691,6 +699,11 @@ class ShardedCheckpointManager(CheckpointManager):
                             "index": [list(se) for se in key],
                             "nbytes": len(blob),
                             "crc32": zlib.crc32(blob),
+                            # blake2b of the blob bytes (see the dense
+                            # manager): tools/ckpt_inspect.py verifies
+                            # shard files jax-free against this
+                            "blake2b": hashlib.blake2b(
+                                blob, digest_size=16).hexdigest(),
                         }
                         self.fs.write_bytes(os.path.join(tmp, entry["file"]),
                                             blob)
@@ -714,7 +727,8 @@ class ShardedCheckpointManager(CheckpointManager):
         return False
 
     def _publish(self, step: int, tmp: str, final: str, specs: List[Any],
-                 world: int) -> None:
+                 world: int,
+                 layout: Optional[Dict[str, Any]] = None) -> None:
         """Rank 0: aggregate per-process manifests, validate coverage,
         write the global manifest into staging, publish atomically."""
         leaves_meta: List[Dict[str, Any]] = [
@@ -734,7 +748,8 @@ class ShardedCheckpointManager(CheckpointManager):
             for ent in pm["shards"]:
                 leaves_meta[ent["leaf"]]["shards"].append(
                     {k: ent[k] for k in ("file", "index", "nbytes",
-                                         "crc32")})
+                                         "crc32", "blake2b")
+                     if k in ent})
         for i, ((shape, _dtype, _regions), meta) in enumerate(
                 zip(specs, leaves_meta)):
             total = int(np.prod(shape)) if shape else 1
@@ -747,7 +762,8 @@ class ShardedCheckpointManager(CheckpointManager):
                     f"many shards")
         manifest = {
             "format_version": MANIFEST_VERSION,
-            "layout": LAYOUT_SHARDED,
+            "layout": ({"storage": LAYOUT_SHARDED, **dict(layout)}
+                       if layout is not None else LAYOUT_SHARDED),
             "step": int(step),
             "created": time.time(),
             "world": world,
@@ -790,7 +806,11 @@ class ShardedCheckpointManager(CheckpointManager):
                 f"{mpath}: bad header (version="
                 f"{manifest.get('format_version')}, "
                 f"step={manifest.get('step')}, expected {step})")
-        if manifest.get("layout") != LAYOUT_SHARDED:
+        layout = manifest.get("layout")
+        sharded = (layout == LAYOUT_SHARDED  # legacy string stamp
+                   or (isinstance(layout, dict)
+                       and layout.get("storage") == LAYOUT_SHARDED))
+        if not sharded:
             # a dense (single-process) step: valid data under the base
             # manager — skip without quarantining
             raise CheckpointLayoutError(
@@ -822,12 +842,19 @@ class ShardedCheckpointManager(CheckpointManager):
                     raise CheckpointCorruptError(
                         f"{fpath}: checksum mismatch (torn, corrupt, or "
                         f"duplicated-over write)")
+                if "blake2b" in ent and hashlib.blake2b(
+                        data,
+                        digest_size=16).hexdigest() != ent["blake2b"]:
+                    raise CheckpointCorruptError(
+                        f"{fpath}: blake2b digest mismatch (crc collision "
+                        f"or manifest tamper)")
                 if _blobs is not None:
                     _blobs[ent["file"]] = data
             if covered != total:
                 raise CheckpointCorruptError(
                     f"{path} leaf {li}: shard coverage {covered}/{total} "
                     f"elements (lost shard file)")
+        self._last_manifest = manifest
         return manifest
 
     def restore(self, step: int, like: Any) -> Any:

@@ -75,6 +75,12 @@ Production failure modes, reproduced on a laptop with a seed:
   exchange). The tier-1 chaos smoke mixes all of them in one seeded
   schedule and asserts bit-identical final params vs an uninterrupted
   run.
+- **Checkpoint storage rot** — ``corrupt_checkpoint_blob(step, leaf)``
+  flips one bit in a COMMITTED step's leaf blob at read time (bit rot
+  discovered on restore, not a torn write): the manager's checksum
+  verification must quarantine exactly that step and fall back to the
+  last good one bit-exactly, while a torn/unparseable manifest is
+  refused loudly — never silently read around.
 - **NaN/Inf gradient bursts** — ``nan_burst(start, length)`` schedules a
   window of steps whose gradients ``poison_grads`` fills with NaN/Inf
   (choice seeded), reproducing the overflow storms that collapse a dynamic
@@ -119,11 +125,16 @@ class _WriteFault:
 
 class _InjectedFilesystem(Filesystem):
     """Filesystem that consults the injector's fault schedule on each
-    write. Reads and directory ops pass through untouched — faults target
-    the durability path."""
+    write — and, for scheduled blob rot, on reads of committed leaf
+    files (every other read and all directory ops pass through
+    untouched)."""
 
     def __init__(self, injector: "FaultInjector"):
         self._injector = injector
+
+    def read_bytes(self, path: str) -> bytes:
+        return self._injector._maybe_corrupt_blob(
+            path, super().read_bytes(path))
 
     def write_bytes(self, path: str, data: bytes) -> None:
         inj = self._injector
@@ -192,6 +203,7 @@ class FaultInjector:
         self._ckpt_crash_steps: set = set()            # checkpoint steps
         self._train_preempts: List[List[int]] = []     # [rank, at_step]
         self._rank_straggles: Dict[int, List[float]] = {}  # rank -> window
+        self._blob_corruptions: set = set()            # (step, leaf index)
 
     # ---- filesystem faults ---------------------------------------------
     def filesystem(self) -> Filesystem:
@@ -557,6 +569,38 @@ class FaultInjector:
             self._ckpt_crash_steps.discard(int(m.group(1)))
             return True
         return False
+
+    def corrupt_checkpoint_blob(self, step: int,
+                                leaf: int = 0) -> "FaultInjector":
+        """Flip one bit in COMMITTED checkpoint ``step``'s leaf ``leaf``
+        blob, at read time (the trainer must run with
+        ``fs=injector.filesystem()``): bit rot that happened on disk
+        after the commit, discovered only when restore reads the file.
+        Matches both the dense manager's ``leaf_NNNNN.npy`` and the
+        sharded manager's ``leaf_NNNNN.part_MMM.npy`` (first part read);
+        staging (``.tmp``) paths never match — the rot targets durable
+        bytes, not in-flight ones. One-shot: consumed on the first
+        matching read, so the post-quarantine walk to the previous step
+        reads clean bytes."""
+        self._blob_corruptions.add((int(step), int(leaf)))
+        return self
+
+    def _maybe_corrupt_blob(self, path: str, data: bytes) -> bytes:
+        """Consumed by the injected filesystem on every read."""
+        if not self._blob_corruptions or not data:
+            return data
+        m = re.search(r"step_(\d{8})/leaf_(\d{5})[^/]*\.npy$",
+                      path.replace(os.sep, "/"))
+        if not m:
+            return data
+        key = (int(m.group(1)), int(m.group(2)))
+        if key not in self._blob_corruptions:
+            return data
+        self._blob_corruptions.discard(key)
+        # flip the low bit of the LAST byte: array payload, not the npy
+        # header — the shape/dtype still parse, only the crc32/blake2b
+        # verification can catch it
+        return data[:-1] + bytes([data[-1] ^ 0x01])
 
     def preempt_at_step(self, at_step: int,
                         rank: int = 0) -> "FaultInjector":

@@ -108,6 +108,31 @@ def permute_qkv(kernel, bias, n_head: int, head_dim: int, tp: int
     return np.concatenate(ks, axis=1), np.concatenate(bs)
 
 
+def unpermute_qkv(kernel, bias, n_head: int, head_dim: int, tp: int
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Exact inverse of :func:`permute_qkv`: gather each projection's
+    per-rank head blocks back into contiguous ``[Wq | Wk | Wv]`` —
+    what turns a TP-serving checkpoint back into the dense training
+    layout. A pure column permutation both ways, so the round trip is
+    byte-identical; the storage layer restates both directions jax-free
+    (:mod:`apex_tpu.resilience.topology`), and tier-1 holds the two
+    implementations bit-identical."""
+    kernel = np.asarray(kernel)
+    bias = np.asarray(bias)
+    loc = (n_head // tp) * head_dim
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for r in range(tp):
+        base = r * 3 * loc
+        qs.append(kernel[:, base:base + loc])
+        ks.append(kernel[:, base + loc:base + 2 * loc])
+        vs.append(kernel[:, base + 2 * loc:base + 3 * loc])
+        bqs.append(bias[base:base + loc])
+        bks.append(bias[base + loc:base + 2 * loc])
+        bvs.append(bias[base + 2 * loc:base + 3 * loc])
+    return (np.concatenate(qs + ks + vs, axis=1),
+            np.concatenate(bqs + bks + bvs))
+
+
 def tp_param_specs(cfg, sync: str) -> Dict[str, Any]:
     """``PartitionSpec`` tree for the TP param layout of
     :func:`build_tp_params` (same dict structure, spec leaves).

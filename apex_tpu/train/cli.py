@@ -21,6 +21,14 @@ step stays restorable), ``preempt:N`` (coordinated drain at step N; with
 ``--elastic`` each drain advances the world schedule), ``nan-burst:N:L``
 (L non-finite steps from N — the overflow-storm guard rail).
 
+``--tp N`` arms the tensor axis: each grad micro-shard's forward/backward
+runs over the PR-15 head-axis mesh (gather-compute-slice — bit-identical
+to ``--tp 1``). Elastic schedules may spell entries ``W`` or ``WxT``, but
+every ``T`` must equal ``--tp``: a live tp resize is refused at parse
+time (exit 2) — changing tp is an explicit checkpoint reshard across a
+restart, never an in-job transition. The device envelope is checked
+up front too: ``max(worlds) × tp`` must fit the host's device count.
+
 Contradictory or inert flag combinations are usage errors (exit 2)
 refused BEFORE anything compiles — the serve/fleet CLI precedent. A
 SIGTERM mid-run triggers the coordinated drain: one final checkpoint
@@ -113,10 +121,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="fixed micro-shard count — the world-"
                          "independent gradient partition that makes "
                          "elastic restarts bit-exact")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: each grad micro-shard "
+                         "runs over the head-axis serving mesh, "
+                         "bit-identical to --tp 1; fixed for the job "
+                         "(a tp change is an explicit reshard)")
     ap.add_argument("--elastic", default=None, metavar="W1:W2:...",
                     help="world schedule: each coordinated preemption "
                          "drain relaunches at the next entry (needs "
-                         "--checkpoint-dir; replaces --world)")
+                         "--checkpoint-dir; replaces --world). Entries "
+                         "may be W or WxT, but T must equal --tp — "
+                         "live tp resizes are refused")
     ap.add_argument("--amp", default="dynamic", choices=["off", "dynamic"])
     ap.add_argument("--checkpoint-dir", default=None,
                     help="sharded atomic checkpoints + elastic restore "
@@ -153,11 +168,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                       "checkpoint carries the state over")
     worlds = [args.world]
     if args.elastic is not None:
-        try:
-            worlds = [int(w) for w in args.elastic.split(":") if w]
-        except ValueError:
-            return _usage(f"--elastic {args.elastic!r}: expected "
-                          f"colon-separated world sizes")
+        worlds = []
+        for ent in args.elastic.split(":"):
+            if not ent:
+                continue
+            w, _, t = ent.partition("x")
+            try:
+                world_n = int(w)
+                tp_n = int(t) if t else args.tp
+            except ValueError:
+                return _usage(f"--elastic {args.elastic!r}: expected "
+                              f"colon-separated world sizes (W or WxT)")
+            if tp_n != args.tp:
+                return _usage(
+                    f"--elastic entry {ent!r}: live tp resize refused — "
+                    f"elastic resizes move the dp axis only (--tp "
+                    f"{args.tp} is fixed for the job); a tp change is "
+                    f"an explicit checkpoint reshard across a restart")
+            worlds.append(world_n)
         if not worlds:
             return _usage("--elastic needs at least one world size")
     for w in worlds:
@@ -186,13 +214,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             steps=args.steps, batch=args.batch, seq=args.seq,
             vocab=args.vocab, hidden=args.hidden, lr=args.lr,
             seed=args.seed, world=worlds[0],
-            grad_shards=args.grad_shards, amp=args.amp,
+            grad_shards=args.grad_shards, tp=args.tp, amp=args.amp,
             checkpoint_dir=args.checkpoint_dir,
             save_every=args.save_every,
             telemetry_jsonl=args.telemetry_jsonl,
             watchdog_timeout_s=args.watchdog_timeout).validate()
     except ValueError as e:
         return _usage(str(e))
+
+    if args.tp > 1:
+        # device-envelope geometry, still before anything compiles: the
+        # certified composition is per-rank dp device blocks × the tp
+        # mesh, so the PEAK scheduled world must fit alongside the mesh
+        import jax
+
+        ndev = len(jax.devices())
+        if max(worlds) * args.tp > ndev:
+            return _usage(
+                f"--tp {args.tp} at world {max(worlds)} needs "
+                f"{max(worlds) * args.tp} devices, have {ndev} — the "
+                f"dp × tp envelope must fit the host (on CPU force "
+                f"more with XLA_FLAGS="
+                f"--xla_force_host_platform_device_count=N)")
 
     injector = None
     if args.chaos is not None:

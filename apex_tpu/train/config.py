@@ -54,6 +54,15 @@ class TrainConfig:
     # the world-independent micro-shard count (see module docstring)
     world: int = 1
     grad_shards: int = 1
+    # tensor-parallel degree: each grad micro-shard's forward/backward
+    # runs over the PR-15 head-axis mesh (serve.tp.serving_mesh). The
+    # per-shard grad fn gathers the sharded params by pure concatenation,
+    # runs the pristine single-chip value_and_grad replicated, and slices
+    # the gradients back to the local chunks — no float add ever crosses
+    # a rank, so tp=N updates are bit-identical to tp=1 (tier-1 asserts).
+    # Elastic resizes stay dp-axis-only: a tp change is an explicit
+    # reshard, refused live (the CLI's world_schedule carries no tp).
+    tp: int = 1
 
     # AMP: "dynamic" = fp16-style dynamic loss scaling through
     # DynamicGradScaler + ResilientStep; "off" = unscaled (bf16-first)
@@ -99,6 +108,13 @@ class TrainConfig:
             raise ValueError(
                 f"grad_shards {self.grad_shards} must divide batch "
                 f"{self.batch}")
+        if self.tp < 1:
+            raise ValueError(f"tp must be >= 1, got {self.tp}")
+        if self.hidden % self.tp:
+            raise ValueError(
+                f"tp {self.tp} must divide hidden {self.hidden} (the "
+                f"built-in workload shards its hidden/head axis; a custom "
+                f"workload's tp_spec axes are checked at placement)")
         if self.amp not in AMP_MODES:
             raise ValueError(f"amp must be one of {AMP_MODES}, "
                              f"got {self.amp!r}")
@@ -112,6 +128,12 @@ class TrainConfig:
             raise ValueError(
                 "world > 1 needs sharded_checkpoint=True (the dense "
                 "manager has no commit protocol across ranks)")
+        if self.tp > 1 and self.checkpoint_dir \
+                and not self.sharded_checkpoint:
+            raise ValueError(
+                "tp > 1 needs sharded_checkpoint=True (mesh-sharded "
+                "leaves stage per-owner shards; the dense manager would "
+                "serialize cross-device gathers on one rank)")
         if self.watchdog_timeout_s is not None \
                 and self.watchdog_timeout_s <= 0:
             raise ValueError(
@@ -125,7 +147,9 @@ class TrainConfig:
         doesn't (checkpoint dirs, telemetry paths), so a restarted or
         elastically resized job with the same workload reuses every
         compiled executable. ``world`` is deliberately absent: shard
-        shapes are world-independent by construction."""
+        shapes are world-independent by construction. ``tp`` is present:
+        a tp change reshapes every per-rank trace (the explicit-reshard
+        boundary elastic resizes must never cross live)."""
         return (self.batch // self.grad_shards, self.seq, self.vocab,
                 self.hidden, self.grad_shards, self.lr, self.amp,
-                self.init_scale, self.scale_floor, self.seed)
+                self.init_scale, self.scale_floor, self.seed, self.tp)

@@ -69,7 +69,11 @@ class TrainSupervisor:
     restores the same sharded checkpoint at the new world — bit-exactly,
     by the trainer's canonical shard reduction). Crash restarts stay on
     the current entry: same topology, zero recompiles. Defaults to
-    ``[config.world]``.
+    ``[config.world]``. Elastic resizes move the **dp axis only**: the
+    tp degree (``config.tp``, plus ``tp_spec`` for a custom workload) is
+    fixed for the job's lifetime — changing it is an explicit reshard of
+    the checkpoint, refused live (the CLI's exit-2 matrix enforces it at
+    parse time).
     """
 
     def __init__(self, config: TrainConfig, *, injector=None,
@@ -78,7 +82,8 @@ class TrainSupervisor:
                  sleep=time.sleep, world_schedule: Optional[List[int]] = None,
                  registry=None, barrier_timeout_s: float = 60.0,
                  loss_fn: Optional[Callable] = None, init_params: Any = None,
-                 batch_fn: Optional[Callable[[int], Any]] = None):
+                 batch_fn: Optional[Callable[[int], Any]] = None,
+                 tp_spec: Any = None):
         self.config = config.validate()
         self.injector = injector
         self.max_restarts = max(0, int(max_restarts))
@@ -88,7 +93,7 @@ class TrainSupervisor:
         self.sleep = sleep
         self.barrier_timeout_s = float(barrier_timeout_s)
         self._custom = {"loss_fn": loss_fn, "init_params": init_params,
-                        "batch_fn": batch_fn}
+                        "batch_fn": batch_fn, "tp_spec": tp_spec}
         worlds = list(world_schedule) if world_schedule else [config.world]
         for w in worlds:
             if w < 1 or config.grad_shards % w:
@@ -174,11 +179,12 @@ class TrainSupervisor:
     def trace_counts(self) -> Dict[str, int]:
         """Aggregate lifetime trace counts over every cached trainer.
         Counter dicts are deduped by identity and then summed: built-in
-        workload trainers share ONE lru-cached dict (so the job total is
-        that dict's count), while custom-``loss_fn`` trainers each carry
-        their own — a per-trainer recompile on an elastic resize shows
-        up in the sum instead of hiding behind a max. ``post`` is always
-        per-trainer."""
+        workload trainers share ONE lru-cached dict per static_key, and
+        custom-``loss_fn`` trainers share one per ``(loss_fn,
+        static_key)`` — so the job total is that dict's count, and any
+        trainer that somehow compiled its own copy (a changed workload
+        mid-job) shows up in the sum instead of hiding behind a max.
+        ``post`` is always per-trainer."""
         out = {"shard_grads": 0, "apply": 0, "post": 0}
         with self._lock:
             trainers = list(self._trainers.values())

@@ -49,6 +49,8 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from apex_tpu.amp.grad_scaler import DynamicGradScaler, ScalerState
 from apex_tpu.monitor.metrics import collect_metrics
@@ -60,6 +62,7 @@ from apex_tpu.resilience.distributed import (CollectiveWatchdog,
                                              SingleProcessCoordinator)
 from apex_tpu.resilience.preemption import PreemptionGuard
 from apex_tpu.resilience.step import ResilientStep
+from apex_tpu.resilience.topology import layout_block
 from apex_tpu.train.config import TrainConfig
 from apex_tpu.utils.logging import is_rank_zero, publish_event
 
@@ -156,6 +159,105 @@ def _tiny_lm_loss(params, tokens):
     return jnp.mean(nll)
 
 
+# --------------------------------------------------------------------------
+# Tensor-parallel gradients (the tp axis of TrainConfig)
+#
+# The mechanism is gather-compute-slice: params live tp-sharded on the
+# PR-15 serving mesh in their RAW axis order (no qkv permutation — the
+# logical checkpoint values stay dense-identical), the shard_map body
+# all_gathers each sharded leaf by pure concatenation (tiled=True —
+# exact reconstruction, no float combine), runs the PRISTINE single-chip
+# value_and_grad of the unmodified loss replicated on every rank, and
+# slices each sharded leaf's gradient back to its local chunk. No AD
+# transpose ever crosses the shard_map boundary and no float add ever
+# crosses a rank, so tp=N gradients — and therefore every update — are
+# bit-identical to tp=1 (tier-1 asserts through GPT-2 + flash attention).
+# --------------------------------------------------------------------------
+
+def builtin_tp_specs() -> Dict[str, P]:
+    """PartitionSpecs for the built-in tiny-LM tree: shard the hidden
+    axis (requires ``tp | hidden`` — config.validate refuses otherwise);
+    a custom workload passes its own spec tree via ``Trainer(tp_spec=)``
+    (the GPT-2 one is :func:`apex_tpu.serve.tp.tp_param_specs`)."""
+    return {"emb": P(None, "tp"), "w1": P(None, "tp"), "b1": P("tp"),
+            "head": P("tp", None)}
+
+
+def _spec_axis(spec: P) -> Optional[int]:
+    for ax, name in enumerate(spec):
+        if name == "tp":
+            return ax
+    return None
+
+
+def _tp_tree_map(fn, tree, specs):
+    return jax.tree_util.tree_map(fn, tree, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+def _gather_tree(tree, specs):
+    def g(leaf, spec):
+        ax = _spec_axis(spec)
+        if ax is None:
+            return leaf
+        return jax.lax.all_gather(leaf, "tp", axis=ax, tiled=True)
+    return _tp_tree_map(g, tree, specs)
+
+
+def _slice_tree(tree, specs, tp: int):
+    r = jax.lax.axis_index("tp")
+
+    def s(leaf, spec):
+        ax = _spec_axis(spec)
+        if ax is None:
+            return leaf
+        chunk = leaf.shape[ax] // tp
+        return jax.lax.dynamic_slice_in_dim(leaf, r * chunk, chunk,
+                                            axis=ax)
+    return _tp_tree_map(s, tree, specs)
+
+
+def _make_shard_grads_tp(loss_fn: Callable, scaler: DynamicGradScaler,
+                         counts: Dict[str, int], mesh, specs):
+    """The tp>1 twin of :func:`_make_shard_grads` — same signature, same
+    outputs (sharded grads + replicated unscaled loss), gather-compute-
+    slice body under ``shard_map``. The trace counter bumps in the OUTER
+    jit wrapper: the shard_map body may legitimately trace more than once
+    per executable, so counting there would break the zero-recompile
+    proofs."""
+    tp = mesh.devices.size
+    sstate_spec = jax.tree_util.tree_map(lambda _: P(), scaler.init())
+
+    def body(params_loc, sstate, tokens):
+        full = _gather_tree(params_loc, specs)
+
+        def scaled(p):
+            loss = loss_fn(p, tokens)
+            return scaler.scale(loss, sstate), loss
+
+        (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(full)
+        return _slice_tree(grads, specs, tp), loss
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(specs, sstate_spec, P()),
+                   out_specs=(specs, P()), check_rep=False)
+
+    def shard_grads(params, sstate, tokens):
+        counts["shard_grads"] += 1
+        return sm(params, sstate, tokens)
+
+    return jax.jit(shard_grads)
+
+
+def _place_tree(tree, mesh, specs):
+    """Commit a tree onto the tp mesh per its specs (replicated leaves
+    get P() so every leaf lands device-committed — eager ops and
+    zeros_like then preserve the placement)."""
+    def p(leaf, spec):
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+    return _tp_tree_map(p, tree, specs)
+
+
 @functools.lru_cache(maxsize=None)
 def _builtin_fns(key):
     """Compiled step functions for the built-in workload, cached on the
@@ -163,14 +265,62 @@ def _builtin_fns(key):
     elastically resized) job with the same workload gets the SAME
     callables back, so jax's jit cache serves every dispatch without a
     retrace. The returned ``counts`` dict is the cache entry's lifetime
-    trace counter."""
+    trace counter; the mesh/specs pair is ``(None, None)`` at tp=1 and
+    the (cached, shared) serving mesh + builtin spec tree at tp>1."""
     (_shard_batch, _seq, _vocab, _hidden, grad_shards, lr, amp,
-     init_scale, _floor, _seed) = key
+     init_scale, _floor, _seed, tp) = key
     counts = {"shard_grads": 0, "apply": 0}
     scaler = DynamicGradScaler(init_scale=init_scale,
                                enabled=amp != "off")
-    return (_make_shard_grads(_tiny_lm_loss, scaler, counts),
-            _make_apply(scaler, counts, grad_shards, lr), counts)
+    if tp > 1:
+        from apex_tpu.serve.tp import serving_mesh
+        mesh, specs = serving_mesh(tp), builtin_tp_specs()
+        grads_fn = _make_shard_grads_tp(_tiny_lm_loss, scaler, counts,
+                                        mesh, specs)
+    else:
+        mesh = specs = None
+        grads_fn = _make_shard_grads(_tiny_lm_loss, scaler, counts)
+    return (grads_fn, _make_apply(scaler, counts, grad_shards, lr),
+            counts, mesh, specs)
+
+
+_CUSTOM_FNS: Dict[Any, tuple] = {}
+
+
+def _custom_fns(loss_fn, key, tp_spec):
+    """The custom-workload twin of :func:`_builtin_fns`: compiled step
+    functions cached on ``(loss_fn, static_key, tp_spec)``. The
+    supervisor rebuilds a Trainer per restart / elastic-resize leg with
+    the SAME loss_fn object, and this cache is what keeps those legs on
+    one compiled callable (zero recompiles) instead of re-jitting the
+    model's grad per leg."""
+    if tp_spec is None:
+        token = None
+    else:
+        leaves, treedef = jax.tree_util.tree_flatten(
+            tp_spec, is_leaf=lambda x: isinstance(x, P))
+        token = (treedef, tuple(leaves))
+    cache_key = (loss_fn, key, token)
+    hit = _CUSTOM_FNS.get(cache_key)
+    if hit is not None:
+        return hit
+    (_shard_batch, _seq, _vocab, _hidden, grad_shards, lr, amp,
+     init_scale, _floor, _seed, tp) = key
+    counts = {"shard_grads": 0, "apply": 0}
+    scaler = DynamicGradScaler(init_scale=init_scale,
+                               enabled=amp != "off")
+    if tp > 1:
+        from apex_tpu.serve.tp import serving_mesh
+        mesh, specs = serving_mesh(tp), tp_spec
+        grads_fn = _make_shard_grads_tp(loss_fn, scaler, counts, mesh,
+                                        specs)
+    else:
+        mesh = specs = None
+        grads_fn = _make_shard_grads(loss_fn, scaler, counts)
+    out = (grads_fn, _make_apply(scaler, counts, grad_shards, lr),
+           counts, mesh, specs)
+    _CUSTOM_FNS[cache_key] = out
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -195,6 +345,7 @@ class Trainer:
                  injector=None, loss_fn: Optional[Callable] = None,
                  init_params: Any = None,
                  batch_fn: Optional[Callable[[int], Any]] = None,
+                 tp_spec: Any = None,
                  registry=None, hwm: int = 0, telemetry=None,
                  install_signal_handlers: bool = False):
         self.config = config.validate()
@@ -217,22 +368,34 @@ class Trainer:
         self._rank0 = self.rank == 0 and is_rank_zero()
 
         self.scaler = make_scaler(config)
+        self.mesh = self.tp_spec = None
         if loss_fn is not None:
             if init_params is None or batch_fn is None:
                 raise ValueError(
                     "a custom loss_fn needs init_params and batch_fn")
-            self._counts = {"shard_grads": 0, "apply": 0}
-            self._shard_grads = _make_shard_grads(loss_fn, self.scaler,
-                                                  self._counts)
-            self._apply = _make_apply(self.scaler, self._counts, self.G,
-                                      config.lr)
+            if config.tp > 1 and tp_spec is None:
+                raise ValueError(
+                    "tp > 1 with a custom loss_fn needs tp_spec (a "
+                    "PartitionSpec tree matching init_params; GPT-2 "
+                    "uses serve.tp.tp_param_specs)")
+            (self._shard_grads, self._apply, self._counts, self.mesh,
+             self.tp_spec) = _custom_fns(
+                 loss_fn, config.static_key(),
+                 tp_spec if config.tp > 1 else None)
             self.params = jax.tree_util.tree_map(jnp.asarray, init_params)
             self._batch_fn = batch_fn
         else:
-            self._shard_grads, self._apply, self._counts = _builtin_fns(
-                config.static_key())
+            (self._shard_grads, self._apply, self._counts, self.mesh,
+             self.tp_spec) = _builtin_fns(config.static_key())
             self.params = tiny_lm_params(config)
             self._batch_fn = lambda t: tiny_lm_batch(config, t)
+        if self.mesh is not None:
+            # commit params onto the tp mesh; moments inherit via
+            # zeros_like, grads come back sharded from the shard_map, and
+            # _apply's elementwise Adam preserves the placement — so the
+            # whole state stays resident in the tp layout step over step
+            self.params = _place_tree(self.params, self.mesh,
+                                      self.tp_spec)
         zeros = lambda p: jnp.zeros_like(p, jnp.float32)  # noqa: E731
         self.m = jax.tree_util.tree_map(zeros, self.params)
         self.v = jax.tree_util.tree_map(zeros, self.params)
@@ -370,21 +533,43 @@ class Trainer:
                 if self._tracer is not None and self._tracer.enabled
                 else contextlib.nullcontext())
         with span:
-            path = self.manager.save(step, self._tree(step))
+            path = self.manager.save(step, self._tree(step),
+                                     layout=self._layout_block())
         self._last_saved_step = step
         if self._rank0:
             publish_event("train_checkpoint_commit", step=int(step),
                           path=path, world=self.world)
         return path
 
+    def _layout_block(self) -> Dict[str, Any]:
+        """The manifest ``layout`` block this topology stamps on every
+        commit: which (dp world, grad_shards, tp) wrote the step. Values
+        are stored in the raw dense format whatever the tp degree — tp
+        shards are raw-axis chunks, so the logical tree is
+        topology-portable by construction."""
+        return layout_block(world=self.world, grad_shards=self.G,
+                            tp=self.config.tp)
+
     def _restore(self) -> Optional[int]:
         out = self.manager.restore_latest(self._tree(0))
+        if self._rank0:
+            for q in getattr(self.manager, "last_quarantined", ()):
+                publish_event("train_ckpt_quarantined", **q)
         if out is None:
             return None
         step, tree = out
         self.params, self.m, self.v = (tree["params"], tree["m"],
                                        tree["v"])
         sc = tree["scaler"]
+        if self.mesh is not None:
+            # restored leaves come back committed to the restore
+            # target's devices; params/m/v restore onto the tp mesh (the
+            # _tree(0) template is mesh-placed) but the scaler scalars'
+            # template is the plain single-device init — re-place them
+            # replicated on the mesh or the jitted step would see two
+            # committed device sets and refuse
+            rep = NamedSharding(self.mesh, P())
+            sc = {k: jax.device_put(v, rep) for k, v in sc.items()}
         self.sstate = ScalerState(sc["scale"], sc["growth"], sc["hyst"])
         meta = tree["meta"]
         r = self._resilient
@@ -398,6 +583,22 @@ class Trainer:
             publish_event("train_elastic_resized",
                           from_world=saved_world, to_world=self.world,
                           step=int(meta["step"]))
+        # topology observability: the manifest's layout block names the
+        # topology that WROTE the step. Restoring reassembles leaves
+        # topology-independently and re-places them onto THIS config's
+        # mesh (the automatic reshard) — when the written tp differs,
+        # that crossing is counted, never silently absorbed.
+        saved_layout = getattr(self.manager, "last_restored_layout",
+                               None)
+        if saved_layout and self._rank0:
+            saved_tp = int(saved_layout.get("tp", 1))
+            if saved_tp != self.config.tp:
+                publish_event(
+                    "train_topology_restored", step=int(meta["step"]),
+                    from_tp=saved_tp, to_tp=self.config.tp,
+                    from_world=int(saved_layout.get("world",
+                                                    saved_world)),
+                    to_world=self.world)
         return step
 
     # ---- the step -------------------------------------------------------

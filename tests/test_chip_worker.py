@@ -121,11 +121,16 @@ class TestRooflineAPI:
         assert 0 < r["achieved_frac"] < 1
 
 
+@pytest.mark.slow
 class TestWorkerEndToEnd:
     def test_runs_queue_and_exits(self, tmp_path):
         """Drive the real worker main() in a subprocess against a
         throwaway queue: one passing job, one failing job (retried to the
-        cap), STOP honored, markers and status written."""
+        cap), STOP honored, markers and status written.
+
+        Slow tier: the subprocess pays a full interpreter + jax import
+        (~16s); the queue/retry/STOP semantics it exercises stay in
+        tier-1 via the in-process unit tests above."""
         q = tmp_path / "q"
         (q / "done").mkdir(parents=True)
         (q / "failed").mkdir()

@@ -75,17 +75,21 @@ _SLOW_CELLS = {("O0", None, None), ("O1", None, None), ("O2", None, None)}
 
 
 def _tier1_cell(ol, ls, bn):
-    """Tier-1 keeps the matrix rows that exercise DISTINCT code paths —
-    every loss-scale at the default bn handling plus the O2 cell that
-    explicitly OPTS OUT of fp32 batchnorm under a static scale
-    (keep_bn=False: master weights × the bn low-precision cast);
-    keep-bn=True stays covered end to end by test_o1_close_to_o0's
-    O1(dynamic, bn=True) run. The remaining bn-flag permutations re-run
-    the same policy machinery at ~8s/cell and ride the slow tier — the
-    full 40-cell matrix still runs without `-m 'not slow'` (tier-1
-    budget: ROADMAP.md)."""
+    """Tier-1 keeps ONE matrix row per DISTINCT code path — the dynamic
+    scaler column at every opt level (the full scale/unscale/update
+    machinery, and each level's first-trace warm-up has to land
+    somewhere), the O3 no-scaler cell (amp without a scaler), and the O2
+    cell that explicitly OPTS OUT of fp32 batchnorm under a static scale
+    (keep_bn=False: master weights × the bn low-precision cast). The
+    static 1.0/128.0 columns re-run the dynamic cells' policy machinery
+    with a different constant (128.0 stays covered tier-1 by that O2 bn
+    cell and test_o2_master_weights_are_fp32); keep-bn=True stays
+    covered end to end by test_o1_close_to_o0's O1(dynamic, bn=True)
+    run. Everything else rides the slow tier at ~4-8s/cell — the full
+    40-cell matrix still runs without `-m 'not slow'` (tier-1 budget:
+    ROADMAP.md)."""
     if bn is None:
-        return True
+        return ls == "dynamic" or (ol, ls) == ("O3", None)
     return (ol, ls, bn) == ("O2", 128.0, False)
 
 

@@ -709,6 +709,55 @@ def test_apx002_covers_autoscaler_handoff_tables(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx002_covers_topology_reshard_table(tmp_path):
+    """PR-19 coverage proof: the real reshard path is pure functions over
+    numpy trees (no shared table, nothing for APX002 to say) — but the
+    tempting bookkeeping of recording in-flight topology restores in a
+    table the supervisor's control thread reads while rank threads
+    append conversions needs a lock the moment it appears: a locked
+    reshard table mutated lock-free from the restore path fires; the
+    lock-disciplined spelling stays quiet."""
+    _fixture(tmp_path, "apex_tpu/resilience/topology.py", """\
+        import threading
+
+        class ReshardTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = {}
+
+            def begin(self, step, src, dst):
+                with self._lock:
+                    self._inflight[step] = (src, dst)
+
+            def on_restored(self, step):
+                # rank restore thread — lock-free completion mark
+                self._inflight[step] = "done"
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 1
+    assert "lock-free" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "resilience" / "topology.py"
+    good.write_text(textwrap.dedent("""\
+        import threading
+
+        class ReshardTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._inflight = {}
+
+            def begin(self, step, src, dst):
+                with self._lock:
+                    self._inflight[step] = (src, dst)
+
+            def on_restored(self, step):
+                with self._lock:
+                    self._inflight[step] = "done"
+        """))
+    active, _ = _run(tmp_path, "APX002")
+    assert not active, [v.format() for v in active]
+
+
 def test_apx005_covers_train_preempt_drain_stamp(tmp_path):
     """PR-14 coverage proof: a trainer preemption drain whose
     ``train_preempt_drain`` seconds are computed from ``time.time()``

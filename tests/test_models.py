@@ -64,7 +64,10 @@ class TestResNet:
             x, use_running_average=True)
         assert bool(jnp.all(jnp.isfinite(y_eval)))
 
+    @pytest.mark.slow
     def test_grads_finite(self):
+        # slow tier: the conv backward compile is ~15s and forward
+        # coverage above keeps ResNet in tier-1
         model = ResNet18ish(num_classes=4)
         x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 16, 3))
         variables = model.init(jax.random.PRNGKey(3), x)
@@ -112,7 +115,10 @@ class TestBert:
         loss = mlm_loss(model, params, ids, labels)
         assert np.isfinite(float(loss))
 
+    @pytest.mark.slow
     def test_attn_mask_path(self):
+        # slow tier: a second full Bert compile for the masked branch;
+        # the unmasked forward above keeps Bert in tier-1
         from apex_tpu.models.bert import Bert, BertConfig
         cfg = BertConfig.tiny()
         model = Bert(cfg)
@@ -160,10 +166,14 @@ class TestProf:
         assert dt > 0 and t.avg > 0
 
 
+@pytest.mark.slow
 class TestReturnHidden:
     def test_hidden_matmul_equals_logits(self):
         """return_hidden=True exposes the pre-logits states the fused
-        LM head consumes: hidden @ wte.T must equal the normal logits."""
+        LM head consumes: hidden @ wte.T must equal the normal logits.
+
+        Slow tier: two full GPT-2 compiles for a static numeric identity
+        that the fused-head training tests exercise end-to-end anyway."""
         cfg = GPT2Config.tiny()
         model = GPT2(cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0,

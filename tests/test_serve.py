@@ -580,10 +580,14 @@ def test_paged_decode_logits_bit_exact_vs_slot_prefill(params, paged3):
             f"paged decode pos {j} drifted: max|d|={np.abs(a - b).max()}"
 
 
+@pytest.mark.slow
 def test_paged_bit_exact_vs_slot_sampled(params):
     """Seeded sampling: the PRNG key is engine state split once per
     prefill/decode call in BOTH layouts, so identical traces consume
-    identical key paths — sampled streams match token-for-token."""
+    identical key paths — sampled streams match token-for-token.
+
+    Slow tier: the greedy paged-vs-slot parity above pins the layout
+    equivalence in tier-1; this adds the PRNG-path leg."""
     kw = dict(temperature=0.8, top_k=5, block_k=8)
     base = _trace_outputs(_engine(params, **kw), _mixed_requests(max_new=6))
     got = _trace_outputs(_engine(params, page_size=8, **kw),
@@ -1062,13 +1066,9 @@ def test_bench_serve_smoke_and_regression_gate(tmp_path, capsys):
                                   "--kernels", "serve_decode"]) == 1
 
 
-def test_serve_cli_paged_smoke(capsys, monkeypatch):
+def test_serve_cli_paged_usage_errors(capsys):
     """``apex-tpu-serve --page-size --prefix-cache``: bad geometry is a
-    clean usage error; a shared-prefix stdin stream serves with one
-    decode compile and a real prefix hit. In-process (the subprocess
-    smoke above covers the entry point)."""
-    import io
-
+    clean usage error — exit 2 before anything compiles."""
     from apex_tpu.serve import cli
 
     # pool geometry that can't exist: exit 2 + the engine's message
@@ -1079,6 +1079,19 @@ def test_serve_cli_paged_smoke(capsys, monkeypatch):
     assert cli.main(["--config", "tiny", "--max-len", "32",
                      "--prefix-cache", "--requests", "1"]) == 2
     assert "prefix_cache" in capsys.readouterr().err
+
+
+@pytest.mark.slow
+def test_serve_cli_paged_smoke(capsys, monkeypatch):
+    """A shared-prefix stdin stream serves through the paged CLI with
+    one decode compile and a real prefix hit. In-process (the subprocess
+    smoke above covers the entry point). Slow tier: the paged engine
+    compile (~11s) duplicates layout coverage the paged-vs-slot
+    bit-exact tests keep in tier-1; the CLI flag plumbing stays tier-1
+    via ``test_serve_cli_paged_usage_errors``."""
+    import io
+
+    from apex_tpu.serve import cli
 
     # one slot serializes the two requests, so the second admission sees
     # the first's prompt pages resident: a real end-to-end prefix hit
@@ -1113,12 +1126,19 @@ def test_serve_bench_usage_errors_exit_clean():
         _serve_bench(steps=2, prompt_len="0:4")
 
 
+@pytest.mark.slow
 def test_paged_bench_capacity_and_gate(tmp_path, capsys):
-    """ISSUE 9 bench acceptance, at tier-1 scale: on a mixed-length
-    shared-prefix workload, the paged capture shows >= 2x resident
-    tokens per HBM byte vs the slot capture at the same workload,
-    prefix_hit_rate > 0, and the capture gates through check_regression
-    with page_size provenance (a lower hit rate regresses)."""
+    """ISSUE 9 bench acceptance: on a mixed-length shared-prefix
+    workload, the paged capture shows >= 2x resident tokens per HBM byte
+    vs the slot capture at the same workload, prefix_hit_rate > 0, and
+    the capture gates through check_regression with page_size provenance
+    (a lower hit rate regresses).
+
+    Slow tier: two full ``_serve_bench`` compiles at max_len=128 are the
+    single heaviest tier-1 item (~48s); the regression-gate direction
+    coverage stays in tier-1 via
+    ``test_bench_serve_smoke_and_regression_gate`` and the paged
+    layout's correctness via the paged-vs-slot bit-exact tests."""
     from apex_tpu.bench_cli import _serve_bench
 
     # mixed 8..24-token prompts + a 16-token fleet-wide system prefix on

@@ -193,12 +193,16 @@ def test_tp_paged_bit_exact_vs_single_chip(paged1, tp2_paged):
     assert tp2_paged.prefix_hits >= 1
 
 
+@pytest.mark.slow
 def test_tp_bit_exact_sampled(params, tp_devices):
     """Seeded sampling crosses the mesh bit-for-bit: logits are
     bit-identical (exact mode) and the PRNG key path is identical (the
     key is engine state split once per call, sampling runs on the full
     replicated logits outside shard_map) — so sampled streams match
-    token-for-token."""
+    token-for-token.
+
+    Slow tier: greedy tp-vs-single-chip parity stays in tier-1; this
+    adds the PRNG-path leg on top of bit-identical logits."""
     kw = dict(temperature=0.8, top_k=5)
     base = _trace_outputs(_engine(params, **kw),
                           _mixed_requests(max_new=6))
@@ -362,11 +366,16 @@ def test_tp_engines_resolve_distinct_block_k_keys(base8, tp2):
 # --------------------------------------------------------- CLI + bench
 
 
+@pytest.mark.slow
 def test_serve_cli_tp_smoke_and_rank_snapshots(tmp_path, capsys):
     """In-process ``apex-tpu-serve --tp 2``: bit-identical greedy output
     to the --tp 1 run, decode compiles once, the final line carries the
     mesh provenance, and --metrics-snapshot writes PATH.tpK per rank
-    plus the merged PATH.tp fleet view."""
+    plus the merged PATH.tp fleet view.
+
+    Slow tier: the two full serve runs cost ~10s; the tp engine
+    bit-exactness and flag matrix stay in tier-1 via the in-process
+    tests above and ``test_serve_cli_tp_exit2_matrix``."""
     from apex_tpu.serve.cli import main
 
     snap = str(tmp_path / "tp.json")

@@ -105,7 +105,11 @@ _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 # These are DEVICE-INDEPENDENT — a fusion that claims a
                 # win must move flops/bytes/op-count, and a regression
                 # here is real work growth no wall-clock noise excuses
-                "flops_per_token", "hbm_bytes_per_token", "ops_total")
+                "flops_per_token", "hbm_bytes_per_token", "ops_total",
+                # topology-portable checkpoints (PR 19): a quarantine
+                # storm (bit-rotted blobs) or unexpected reshard churn
+                # on restore gates off a zero baseline
+                "ckpt_quarantined", "topology_restored")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated

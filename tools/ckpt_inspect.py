@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Inspect + verify a committed checkpoint directory, without jax.
+
+Dumps the newest (or ``--step N``) committed step's manifest as JSON:
+the step number, the ``layout`` block (which (dp world, grad_shards, tp)
+topology wrote it, and whether the files are dense or sharded), and the
+per-leaf shapes, dtypes, and digests — then re-reads every referenced
+blob file and verifies its byte length, crc32, and (when stamped)
+blake2b-128 against the manifest.
+
+Usage::
+
+    python tools/ckpt_inspect.py /ckpt                 # newest step
+    python tools/ckpt_inspect.py /ckpt --step 8        # a specific step
+    python tools/ckpt_inspect.py /ckpt --no-verify     # manifest only
+
+Exit status: 0 verified (or listed with ``--no-verify``), 2 on a torn or
+unparseable manifest, a missing/short blob, or any digest mismatch — the
+same refuse-loudly contract the restore path enforces, available from an
+operator box that has no jax (or whose jax must not be imported by a
+forensic tool). This tool is **standalone stdlib**: digests cover the
+serialized blob bytes, so nothing here parses npy.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+import zlib
+from typing import Any, Dict, List, Optional
+
+MANIFEST_NAME = "manifest.json"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+class InspectError(Exception):
+    """A torn manifest, a missing blob, or a digest mismatch."""
+
+
+def committed_steps(directory: str) -> List[int]:
+    try:
+        names = os.listdir(directory)
+    except OSError as e:
+        raise InspectError(f"{directory}: {e}") from e
+    return sorted(int(m.group(1)) for n in names
+                  if (m := _STEP_RE.match(n)))
+
+
+def _read_manifest(path: str) -> Dict[str, Any]:
+    mpath = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise InspectError(f"{path}: missing {MANIFEST_NAME} (torn or "
+                           f"uncommitted step)")
+    try:
+        with open(mpath, "rb") as f:
+            manifest = json.loads(f.read())
+    except (ValueError, OSError) as e:
+        raise InspectError(f"{mpath}: torn manifest ({e})")
+    if not isinstance(manifest, dict) or "leaves" not in manifest:
+        raise InspectError(f"{mpath}: torn manifest (no leaf table)")
+    leaves = manifest["leaves"]
+    if not isinstance(leaves, list) or \
+            len(leaves) != manifest.get("num_leaves"):
+        raise InspectError(f"{mpath}: torn manifest (leaf table "
+                           f"truncated: {len(leaves)} of "
+                           f"{manifest.get('num_leaves')})")
+    return manifest
+
+
+def _verify_blob(path: str, ent: Dict[str, Any]) -> None:
+    fpath = os.path.join(path, ent["file"])
+    if not os.path.exists(fpath):
+        raise InspectError(f"{fpath}: missing blob file")
+    with open(fpath, "rb") as f:
+        data = f.read()
+    if len(data) != ent.get("nbytes"):
+        raise InspectError(f"{fpath}: {len(data)} bytes, manifest says "
+                           f"{ent.get('nbytes')}")
+    if zlib.crc32(data) != ent.get("crc32"):
+        raise InspectError(f"{fpath}: crc32 mismatch")
+    want = ent.get("blake2b")
+    if want is not None and hashlib.blake2b(
+            data, digest_size=16).hexdigest() != want:
+        raise InspectError(f"{fpath}: blake2b digest mismatch")
+
+
+def inspect_step(directory: str, step: int,
+                 verify: bool = True) -> Dict[str, Any]:
+    """The inspection record for one committed step (raises
+    :class:`InspectError` on anything the restore path would refuse)."""
+    path = os.path.join(directory, f"step_{step:08d}")
+    manifest = _read_manifest(path)
+    layout = manifest.get("layout")
+    # the layout block is polymorphic: absent (legacy dense), the legacy
+    # "sharded" string, or a dict with a "storage" discriminator
+    if isinstance(layout, dict):
+        storage = layout.get("storage", "dense")
+    elif isinstance(layout, str):
+        storage, layout = layout, None
+    else:
+        storage = "dense"
+    leaves_out = []
+    checked = 0
+    for i, leaf in enumerate(manifest["leaves"]):
+        if "shards" in leaf:  # sharded manifest: per-region entries
+            ents = leaf["shards"]
+            rec: Dict[str, Any] = {
+                "leaf": i, "shape": leaf.get("shape"),
+                "dtype": leaf.get("dtype"), "shards": len(ents),
+                "blake2b": [e.get("blake2b") for e in ents],
+            }
+        else:  # dense manifest: the leaf IS one blob entry
+            ents = [leaf]
+            rec = {"leaf": i, "shape": leaf.get("shape"),
+                   "dtype": leaf.get("dtype"), "file": leaf.get("file"),
+                   "blake2b": leaf.get("blake2b")}
+        if verify:
+            for ent in ents:
+                _verify_blob(path, ent)
+                checked += 1
+        leaves_out.append(rec)
+    return {"step": step, "path": path, "storage": storage,
+            "layout": layout, "num_leaves": len(leaves_out),
+            "blobs_verified": checked if verify else None,
+            "leaves": leaves_out}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="dump + digest-verify a committed checkpoint's "
+                    "manifest (step, topology layout block, per-leaf "
+                    "shapes/digests) without importing jax")
+    ap.add_argument("directory", help="the checkpoint directory")
+    ap.add_argument("--step", type=int, default=None,
+                    help="inspect this committed step (default: newest)")
+    ap.add_argument("--no-verify", action="store_true",
+                    help="dump the manifest without re-reading blobs")
+    args = ap.parse_args(argv)
+
+    try:
+        steps = committed_steps(args.directory)
+        if not steps:
+            raise InspectError(f"{args.directory}: no committed steps")
+        step = args.step if args.step is not None else steps[-1]
+        if step not in steps:
+            raise InspectError(
+                f"step {step} is not committed (have: {steps})")
+        record = inspect_step(args.directory, step,
+                              verify=not args.no_verify)
+    except InspectError as e:
+        print(f"ckpt_inspect: {e}", file=sys.stderr)
+        return 2
+    record["all_steps"] = steps
+    print(json.dumps(record, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
