@@ -394,7 +394,8 @@ def _serve_bench(steps: int, num_slots: int = 4,
                  cost_ledger: "str | None" = None,
                  chip_spec: "str | None" = None,
                  spec_draft_len: "int | None" = None,
-                 decode_policy: "str | None" = None) -> None:
+                 decode_policy: "str | None" = None,
+                 kv_quant: "str | None" = None) -> None:
     """Serving micro-bench: a scripted continuous-batching workload on the
     tiny fp32 GPT-2 — tokens/s, p50/p99 per-token decode latency, and TTFT
     in the BENCH_SUITE entry shape, ready for the check_regression suite
@@ -488,6 +489,22 @@ def _serve_bench(steps: int, num_slots: int = 4,
             parse_policy(decode_policy, spec_draft_len=spec_k)
         except ValueError as e:
             raise SystemExit(f"apex-tpu-bench: --decode-policy: {e}")
+    # KV-quantization matrix (same discipline): the bench engine is
+    # fp32 by construction, so only the codec itself and the spec
+    # conflict need refusing before any compile
+    if kv_quant is not None:
+        if spec_k:
+            raise SystemExit(
+                f"apex-tpu-bench: --kv-quant {kv_quant} is incompatible "
+                f"with --spec-draft-len {spec_k}: the speculative "
+                f"acceptance oracle is bit-exact, the quantized cache "
+                f"is tolerance-gated (drop one)")
+        from apex_tpu.quant.kv import check_kv_codec
+
+        try:
+            check_kv_codec(kv_quant)
+        except ValueError as e:
+            raise SystemExit(f"apex-tpu-bench: --kv-quant: {e}")
     # cost-ledger matrix (same inert/contradictory-flag discipline):
     # validated against the ledger module's own chip-spec table BEFORE
     # any params/compile work
@@ -677,7 +694,8 @@ def _serve_bench(steps: int, num_slots: int = 4,
                                        prefix_cache=prefix_cache,
                                        tp=tp, tp_sync=tp_sync,
                                        spec_draft_len=spec_k,
-                                       decode_policy=decode_policy),
+                                       decode_policy=decode_policy,
+                                       kv_quant=kv_quant),
                           seed=0)
                    for _ in range(replicas)]
     except ValueError as e:
@@ -1007,7 +1025,14 @@ def _serve_bench(steps: int, num_slots: int = 4,
                          # rather than silently gate)
                          "spec": bool(spec_k),
                          "draft_len": spec_k,
-                         "decode_policy": decode_policy},
+                         "decode_policy": decode_policy,
+                         # quantization provenance: a quantized
+                         # capture's capacity/latency numbers are a
+                         # different workload — the gate refuses to
+                         # compare across codec or block (missing key
+                         # = unquantized, the pre-quant default)
+                         "kv_quant": kv_quant,
+                         "quant_block": int(engine.quant_block)},
             # a subset capture, not the full committed suite
             "complete": False,
         },
@@ -1105,7 +1130,8 @@ def main() -> None:
                                                 "--cost-ledger",
                                                 "--chip-spec",
                                                 "--spec-draft-len",
-                                                "--decode-policy")]
+                                                "--decode-policy",
+                                                "--kv-quant")]
         if serve_only and not has_serve:
             # without --serve these would silently fall through to the
             # kernel bench — the inert-flag class this matrix refuses
@@ -1322,6 +1348,16 @@ def main() -> None:
                                  "with optional ',t=T' (beam-like "
                                  "policies are refused — no exact "
                                  "per-token acceptance test exists)")
+            ap.add_argument("--kv-quant", default=None,
+                            choices=["int8", "mxfp8"],
+                            help="block-scale KV-cache quantization: "
+                                 "K/V pages as codec bytes + per-"
+                                 "(token, head) fp32 scales — the "
+                                 "entry's resident_tokens_per_hbm_byte "
+                                 "carries the capacity win and the "
+                                 "kv_quant/quant_block workload axes "
+                                 "refuse fp32 baselines (incompatible "
+                                 "with --spec-draft-len)")
             args, _ = ap.parse_known_args(sys.argv[1:])
             _serve_bench(args.steps, args.serve_slots,
                          args.emit_baseline,
@@ -1349,7 +1385,8 @@ def main() -> None:
                          cost_ledger=args.cost_ledger,
                          chip_spec=args.chip_spec,
                          spec_draft_len=args.spec_draft_len,
-                         decode_policy=args.decode_policy)
+                         decode_policy=args.decode_policy,
+                         kv_quant=args.kv_quant)
         elif has_telemetry:
             import argparse
 

@@ -152,7 +152,7 @@ def _affine_layer_norm(x, scale, bias, eps: float = 1e-5):
 
 
 def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
-                       write_mask, *, block_k=None,
+                       write_mask, *, block_k=None, kv_quant=None,
                        final_scope: str = "sampling"):
     """One decode token per slot through GPT-2 with the serving KV cache.
 
@@ -176,6 +176,14 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
     attention chunk arithmetic is shared, so the two layouts are
     bit-identical in fp32 on identical resident bytes at equal
     ``block_k`` (the chunk size orders the softmax partial sums).
+
+    ``kv_quant`` (``"int8"``/``"mxfp8"``, static trace-time string) arms
+    the block-scale KV codec: each appended token's K/V is encoded with
+    one fp32 scale per head inside the write, and attention dequantizes
+    per streamed chunk from the cache's scale planes. Encode is
+    deterministic, so prefill and decode still produce bit-identical
+    cache bytes for the same token at the same position (the PR-5
+    invariant survives quantization).
     """
     from apex_tpu.serve.attention import cached_attention, paged_attention
     from apex_tpu.serve.kv_cache import paged_write_token, write_token
@@ -212,14 +220,23 @@ def gpt2_token_forward(cfg: GPT2Config, params, cache, tokens, positions,
         with jax.named_scope("attention"):
             if paged:
                 cache = paged_write_token(cache, i, k, v, pos,
-                                          write_mask)
-                o = paged_attention(q, cache.k[i], cache.v[i],
-                                    cache.page_table, pos,
-                                    block_k=block_k)
+                                          write_mask, codec=kv_quant)
+                o = paged_attention(
+                    q, cache.k[i], cache.v[i], cache.page_table, pos,
+                    block_k=block_k,
+                    k_scale=(None if kv_quant is None
+                             else cache.k_scale[i]),
+                    v_scale=(None if kv_quant is None
+                             else cache.v_scale[i]))
             else:
-                cache = write_token(cache, i, k, v, pos, write_mask)
-                o = cached_attention(q, cache.k[i], cache.v[i], pos,
-                                     block_k=block_k)
+                cache = write_token(cache, i, k, v, pos, write_mask,
+                                    codec=kv_quant)
+                o = cached_attention(
+                    q, cache.k[i], cache.v[i], pos, block_k=block_k,
+                    k_scale=(None if kv_quant is None
+                             else cache.k_scale[i]),
+                    v_scale=(None if kv_quant is None
+                             else cache.v_scale[i]))
             o = o.reshape(-1, c.n_embd)
             x = x + (o.astype(dt) @ blk["attn_out"]["kernel"].astype(dt)
                      + blk["attn_out"]["bias"].astype(dt))
@@ -264,7 +281,8 @@ def _psum_halves_into(part, resid, bias, axis_name, ln=None):
 
 def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
                           cache, tokens, positions, write_mask, *,
-                          block_k=None, axis_name: str = "tp",
+                          block_k=None, kv_quant=None,
+                          axis_name: str = "tp",
                           final_scope: str = "sampling"):
     """The PER-RANK body of the tensor-parallel single-token forward —
     run under ``shard_map`` over the serving mesh (``apex_tpu.serve.tp``
@@ -326,16 +344,29 @@ def gpt2_token_forward_tp(cfg: GPT2Config, tp: int, sync: str, params,
             k = k.reshape(-1, h_loc, d)
             v = v.reshape(-1, h_loc, d)
         with jax.named_scope("attention"):
+            # per-head encode is rank-local (a head's scale reduces only
+            # over that head's head_dim), so this rank's shard of the
+            # quantized pool is bit-identical to the single-chip
+            # engine's same head slice
             if paged:
                 cache = paged_write_token(cache, i, k, v, pos,
-                                          write_mask)
-                o = paged_attention(q, cache.k[i], cache.v[i],
-                                    cache.page_table, pos,
-                                    block_k=block_k)
+                                          write_mask, codec=kv_quant)
+                o = paged_attention(
+                    q, cache.k[i], cache.v[i], cache.page_table, pos,
+                    block_k=block_k,
+                    k_scale=(None if kv_quant is None
+                             else cache.k_scale[i]),
+                    v_scale=(None if kv_quant is None
+                             else cache.v_scale[i]))
             else:
-                cache = write_token(cache, i, k, v, pos, write_mask)
-                o = cached_attention(q, cache.k[i], cache.v[i], pos,
-                                     block_k=block_k)
+                cache = write_token(cache, i, k, v, pos, write_mask,
+                                    codec=kv_quant)
+                o = cached_attention(
+                    q, cache.k[i], cache.v[i], pos, block_k=block_k,
+                    k_scale=(None if kv_quant is None
+                             else cache.k_scale[i]),
+                    v_scale=(None if kv_quant is None
+                             else cache.v_scale[i]))
             out_b = blk["attn_out"]["bias"].astype(dt)
             if sync == "exact":
                 # concatenate the heads across ranks, then the FULL

@@ -707,6 +707,12 @@ LEDGER_INCOMPARABLE_KEYS = {
     # one-token ledger. Missing keys = speculation off (pre-PR-18
     # ledgers are one-token by construction).
     "spec_draft_len": 0, "decode_policy": None,
+    # block-scale KV quantization (apex_tpu.quant): a quantized
+    # decode step's HBM bytes are the codec bytes + scale planes — a
+    # real win that must never gate against an fp32 ledger as if it
+    # were an optimization of the same workload. Missing keys =
+    # unquantized (pre-quant ledgers stored full-width K/V).
+    "kv_quant": None, "quant_block": 0,
 }
 
 

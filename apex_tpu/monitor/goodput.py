@@ -151,6 +151,14 @@ COUNTED_EVENTS = (
     # back by cache-length truncation — counted, never timed: the cost
     # of a rejection is already inside the verify step's wall time
     "serve_spec_draft_accepted", "serve_spec_draft_rejected",
+    # block-scale KV quantization (apex_tpu.quant, EngineConfig
+    # kv_quant): pages committed as codec bytes + per-(token, head)
+    # scales in one prefill (the quantized-capacity provenance a bench
+    # capture rides on), and one disaggregated handoff refused because
+    # the source and target disagreed on quantization (codec mismatch —
+    # the request fell back to local re-prefill, bit-exact by the same
+    # mechanism as a digest refusal)
+    "serve_kv_quantized_pages", "serve_quant_fallback",
 )
 
 # informational events: on the bus for tracing/provenance/postmortem

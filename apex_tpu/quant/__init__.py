@@ -1,0 +1,51 @@
+"""Block-scale low-precision subsystem (ROADMAP item 4, *MXNorm*).
+
+The framework's first-class quantization layer, built from four pieces:
+
+- :mod:`~apex_tpu.quant.blockscale` — the codec core: symmetric int8
+  and MXFP8-style shared-exponent encode/decode with a static block
+  size, plus the pure-fp32 numpy reference implementations that stay
+  the oracle (property-tested round-trip error bounds in tier-1).
+- :mod:`~apex_tpu.quant.kv` — the KV-cache codec glue the serve engine
+  consumes: per-token per-HEAD scales (block = ``head_dim``, the only
+  granularity compatible with incremental decode appends), storage
+  dtypes, and the build-time codec validation every CLI surfaces as
+  exit 2.
+- :mod:`~apex_tpu.quant.matmul` — per-block weight scales for the
+  projection matmuls, block size keyed alongside the tune registry
+  (``tuned_params("quant_matmul", ...)``).
+- :mod:`~apex_tpu.quant.norms` — the MXNorm layer_norm: mean/variance
+  from per-block integer sums rescaled by the SAME block scales the
+  quantized matmul carries, instead of re-reducing the dequantized
+  activations.
+
+Quality policy (docs/quantization.md): quantized paths are gated by a
+TOLERANCE oracle (perplexity delta vs the fp32 engine, documented
+bound) — deliberately unlike the serve engine's bit-exact oracles. The
+fp32 reference implementations in :mod:`blockscale` are themselves
+held bit-exact against the jax codecs, so the tolerance is spent on
+quantization error alone, never on implementation drift.
+"""
+
+from apex_tpu.quant.blockscale import (decode_int8, decode_int8_ref,
+                                       decode_mxfp8, decode_mxfp8_ref,
+                                       encode_int8, encode_int8_ref,
+                                       encode_mxfp8, encode_mxfp8_ref,
+                                       has_float8, int8_error_bound,
+                                       mxfp8_error_bound)
+from apex_tpu.quant.kv import (KV_CODECS, check_kv_codec, decode_kv,
+                               encode_kv, kv_storage_dtype)
+from apex_tpu.quant.matmul import (quant_matmul, quantize_weight,
+                                   resolve_quant_block)
+from apex_tpu.quant.norms import mx_layer_norm
+
+__all__ = [
+    "encode_int8", "decode_int8", "encode_mxfp8", "decode_mxfp8",
+    "encode_int8_ref", "decode_int8_ref", "encode_mxfp8_ref",
+    "decode_mxfp8_ref",
+    "has_float8", "int8_error_bound", "mxfp8_error_bound",
+    "KV_CODECS", "check_kv_codec", "encode_kv", "decode_kv",
+    "kv_storage_dtype",
+    "quantize_weight", "quant_matmul", "resolve_quant_block",
+    "mx_layer_norm",
+]

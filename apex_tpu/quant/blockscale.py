@@ -1,0 +1,177 @@
+"""Block-scale codec core: symmetric int8 and MXFP8 shared-exponent.
+
+Both codecs share one shape contract: the input's LAST axis is split
+into contiguous blocks of a static ``block`` size, each block gets one
+fp32 scale, and encode returns ``(codes, scales)`` where ``codes`` has
+the input shape (storage dtype) and ``scales`` has the input shape
+with the last axis divided by ``block``.
+
+Codecs
+------
+``int8``   symmetric linear: ``scale = amax / 127`` per block,
+           ``q = clip(round(x / scale), -127, 127)`` stored as int8.
+           Zero blocks take ``scale = 1.0`` so decode is exact there.
+``mxfp8``  MXFP-style shared exponent: the per-block scale is the
+           smallest POWER OF TWO ``2**e`` such that ``amax / 2**e``
+           fits in float8_e4m3fn (max normal 448); the payload is the
+           rescaled value cast to ``float8_e4m3fn`` (1 byte). e4m3fn
+           has no inf — overflow saturates via an explicit clamp.
+
+Oracles: ``encode_*_ref`` / ``decode_*_ref`` are pure-numpy fp32
+implementations, property-tested against the jax codecs in
+``tests/test_quant.py``. int8 is BIT-EXACT both ways. mxfp8 scales
+are bit-exact; the payload may differ by at most ONE e4m3 grid step
+on near-tie values — XLA's compiled f32->f8 convert double-rounds
+through an intermediate precision (observed on CPU: -11.49896 casts
+to -12 where ml_dtypes' direct round-to-nearest gives -11). Both
+spellings stay inside the round-trip error bound below, which is the
+contract the engine's quality gate rides on.
+
+Error bounds (tested, not just documented):
+
+- int8:  ``|x - dec(enc(x))| <= scale / 2`` per element (round-to-
+  nearest on a linear grid of pitch ``scale``).
+- mxfp8: ``|x - dec(enc(x))| <= |x| * 2**-3 + scale * 2**-9`` — e4m3
+  has 3 mantissa bits (relative error ``2**-3`` covers round-to-
+  nearest-even generously) and the subnormal grid near zero has pitch
+  ``2**-9`` in rescaled units.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+INT8_QMAX = 127.0
+# float8_e4m3fn: max normal = 1.75 * 2**8 = 448, no inf (overflow is
+# NaN without the clamp below), smallest subnormal = 2**-9.
+MXFP8_MAX = 448.0
+_F32 = jnp.float32
+
+
+def has_float8() -> bool:
+    """Whether this jax build exposes ``float8_e4m3fn`` storage."""
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def _check_block(x_shape, block: int) -> None:
+    block = int(block)
+    if block <= 0:
+        raise ValueError(f"quant block must be positive, got {block}")
+    last = int(x_shape[-1])
+    if last % block != 0:
+        raise ValueError(
+            f"quant block {block} does not divide last axis {last}")
+
+
+def _blocked(x: jnp.ndarray, block: int) -> jnp.ndarray:
+    return x.reshape(x.shape[:-1] + (x.shape[-1] // block, block))
+
+
+# ---------------------------------------------------------------- int8
+
+def encode_int8(x: jnp.ndarray, block: int):
+    """Symmetric per-block int8. Returns ``(codes int8, scales f32)``."""
+    _check_block(x.shape, block)
+    xb = _blocked(x.astype(_F32), block)
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, jnp.ones_like(amax))
+    q = jnp.clip(jnp.round(xb / scale[..., None]), -INT8_QMAX, INT8_QMAX)
+    return q.astype(jnp.int8).reshape(x.shape), scale
+
+
+def decode_int8(codes: jnp.ndarray, scales: jnp.ndarray, block: int):
+    _check_block(codes.shape, block)
+    qb = _blocked(codes.astype(_F32), block)
+    return (qb * scales[..., None]).reshape(codes.shape)
+
+
+def encode_int8_ref(x: np.ndarray, block: int):
+    """Pure-numpy fp32 reference; bit-exact vs :func:`encode_int8`."""
+    _check_block(x.shape, block)
+    xb = np.asarray(x, np.float32)
+    xb = xb.reshape(xb.shape[:-1] + (xb.shape[-1] // block, block))
+    amax = np.max(np.abs(xb), axis=-1)
+    scale = np.where(amax > 0, amax / np.float32(INT8_QMAX),
+                     np.float32(1.0)).astype(np.float32)
+    q = np.clip(np.round(xb / scale[..., None]), -INT8_QMAX, INT8_QMAX)
+    return q.astype(np.int8).reshape(x.shape), scale
+
+
+def decode_int8_ref(codes: np.ndarray, scales: np.ndarray, block: int):
+    qb = np.asarray(codes, np.float32)
+    qb = qb.reshape(qb.shape[:-1] + (qb.shape[-1] // block, block))
+    out = qb * np.asarray(scales, np.float32)[..., None]
+    return out.astype(np.float32).reshape(codes.shape)
+
+
+def int8_error_bound(scales, block: int, shape) -> np.ndarray:
+    """Per-element bound on ``|x - roundtrip(x)|``: half a grid step."""
+    s = np.asarray(scales, np.float32)[..., None]
+    b = np.broadcast_to(s / 2, s.shape[:-1] + (block,))
+    return b.reshape(shape) + np.float32(1e-7)
+
+
+# --------------------------------------------------------------- mxfp8
+
+def _mxfp8_scale(amax: jnp.ndarray) -> jnp.ndarray:
+    # Smallest power of two 2**e with amax / 2**e <= 448. ceil(log2)
+    # over-shoots by at most one binade, which only costs the bottom
+    # subnormal bit — the error bound below already covers it. ldexp,
+    # NOT exp2: XLA lowers exp2 through a polynomial whose result is
+    # off by an ulp at large |e| (2**-29 came back 1.8626442e-09),
+    # silently breaking the exact-power-of-two scale contract.
+    tiny = jnp.float32(np.finfo(np.float32).tiny)
+    e = jnp.ceil(jnp.log2(jnp.maximum(amax, tiny) / MXFP8_MAX))
+    pow2 = jnp.ldexp(jnp.ones_like(amax), e.astype(jnp.int32))
+    return jnp.where(amax > 0, pow2, jnp.ones_like(amax))
+
+
+def encode_mxfp8(x: jnp.ndarray, block: int):
+    """MXFP8: power-of-two block scale + float8_e4m3fn payload."""
+    if not has_float8():
+        raise ValueError(
+            "mxfp8 codec requires jax.numpy.float8_e4m3fn support")
+    _check_block(x.shape, block)
+    xb = _blocked(x.astype(_F32), block)
+    scale = _mxfp8_scale(jnp.max(jnp.abs(xb), axis=-1))
+    y = jnp.clip(xb / scale[..., None], -MXFP8_MAX, MXFP8_MAX)
+    return y.astype(jnp.float8_e4m3fn).reshape(x.shape), scale
+
+
+def decode_mxfp8(codes: jnp.ndarray, scales: jnp.ndarray, block: int):
+    _check_block(codes.shape, block)
+    qb = _blocked(codes.astype(_F32), block)
+    return (qb * scales[..., None]).reshape(codes.shape)
+
+
+def encode_mxfp8_ref(x: np.ndarray, block: int):
+    """Pure-numpy reference: same scale rule, payload via ml_dtypes."""
+    import ml_dtypes  # ships with jax; not a new dependency
+    _check_block(x.shape, block)
+    xb = np.asarray(x, np.float32)
+    xb = xb.reshape(xb.shape[:-1] + (xb.shape[-1] // block, block))
+    amax = np.max(np.abs(xb), axis=-1)
+    tiny = np.finfo(np.float32).tiny
+    e = np.ceil(np.log2(np.maximum(amax, tiny) / np.float32(MXFP8_MAX)))
+    pow2 = np.ldexp(np.float32(1.0), e.astype(np.int32))
+    scale = np.where(amax > 0, pow2, np.float32(1.0)).astype(np.float32)
+    y = np.clip(xb / scale[..., None], -MXFP8_MAX, MXFP8_MAX)
+    codes = y.astype(ml_dtypes.float8_e4m3fn).reshape(x.shape)
+    return codes, scale
+
+
+def decode_mxfp8_ref(codes: np.ndarray, scales: np.ndarray, block: int):
+    qb = np.asarray(codes, np.float32)
+    qb = qb.reshape(qb.shape[:-1] + (qb.shape[-1] // block, block))
+    out = qb * np.asarray(scales, np.float32)[..., None]
+    return out.astype(np.float32).reshape(codes.shape)
+
+
+def mxfp8_error_bound(x, scales, block: int) -> np.ndarray:
+    """Per-element bound: 3 mantissa bits + subnormal grid pitch."""
+    xa = np.abs(np.asarray(x, np.float32))
+    s = np.asarray(scales, np.float32)[..., None]
+    s = np.broadcast_to(s, s.shape[:-1] + (block,)).reshape(xa.shape)
+    return xa * np.float32(2.0 ** -3) + s * np.float32(2.0 ** -9)

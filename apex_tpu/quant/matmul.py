@@ -1,0 +1,83 @@
+"""Quantized projection matmuls with per-block weight scales.
+
+Weights are quantized along the REDUCTION axis in contiguous blocks of
+``block`` elements: ``w [in, out]`` becomes int8 codes ``[in, out]``
+plus fp32 scales ``[in // block, out]``. The matmul accumulates one
+fp32 partial per block and applies that block's scale before the final
+sum:
+
+    y[s, o] = sum_n ( sum_b x[s, n*B + b] * q[n*B + b, o] ) * scale[n, o]
+
+Dequantize-then-matmul and blockwise-rescale differ only in float
+association, so the oracle here is a TOLERANCE against the fp32
+matmul of the dequantized weight (see docs/quantization.md) — unlike
+the serve engine's bit-exact oracles.
+
+The block size is keyed alongside the tune registry
+(``tuned_params("quant_matmul", ...)``) so a tuning sweep can pin a
+different block per (shape-bucket, dtype, chip) exactly like Pallas
+tile geometry; the default is the largest power of two ≤ 128 dividing
+the reduction dim.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.quant import blockscale
+from apex_tpu.tune.api import pow2_bucket, tuned_params
+
+_F32 = jnp.float32
+
+
+def _default_block(in_dim: int) -> int:
+    b = 1
+    while b * 2 <= min(int(in_dim), 128) and in_dim % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def resolve_quant_block(in_dim: int, out_dim: int, *, dtype=jnp.int8,
+                        block: Optional[int] = None,
+                        interpret: Optional[bool] = None) -> int:
+    """Pick the weight-scale block for an ``[in_dim, out_dim]`` matmul:
+    explicit override > tuned cache entry > largest pow2 divisor ≤ 128."""
+    if block is not None:
+        if in_dim % int(block) != 0:
+            raise ValueError(
+                f"quant block {block} does not divide in_dim {in_dim}")
+        return int(block)
+    shape_key = (("in", int(in_dim)), ("out", pow2_bucket(int(out_dim))))
+    params = tuned_params(
+        "quant_matmul", shape_key, {"block": _default_block(in_dim)},
+        dtype=dtype, interpret=interpret,
+        validate=lambda p: in_dim % int(p["block"]) == 0)
+    return int(params["block"])
+
+
+def quantize_weight(w: jnp.ndarray, block: int):
+    """Encode ``w [in, out]`` -> int8 codes ``[in, out]`` + fp32 scales
+    ``[in // block, out]`` (per-block along the reduction axis)."""
+    if w.ndim != 2:
+        raise ValueError(f"quantize_weight expects [in, out], got {w.shape}")
+    codes_t, scales_t = blockscale.encode_int8(w.T, block)
+    return codes_t.T, scales_t.T
+
+
+def quant_matmul(x: jnp.ndarray, codes: jnp.ndarray, scales: jnp.ndarray,
+                 block: int) -> jnp.ndarray:
+    """``x [..., in] @ dequant(codes, scales) [in, out]`` with the scale
+    applied per reduction block on the fp32 partials."""
+    in_dim, out_dim = codes.shape
+    if x.shape[-1] != in_dim:
+        raise ValueError(
+            f"x last axis {x.shape[-1]} != weight in_dim {in_dim}")
+    n = in_dim // block
+    lead = x.shape[:-1]
+    xb = x.astype(_F32).reshape((-1, n, block))
+    wb = codes.astype(_F32).reshape((n, block, out_dim))
+    partials = jnp.einsum("snb,nbo->sno", xb, wb)
+    y = jnp.sum(partials * scales.astype(_F32)[None], axis=1)
+    return y.reshape(lead + (out_dim,))

@@ -1,0 +1,55 @@
+"""MXNorm: layer_norm that REUSES the matmul's block scales.
+
+A quantized activation already carries per-block scales from the
+codec. The naive quantized layer_norm dequantizes and re-reduces the
+fp32 vector twice (mean, then variance). MXNorm (PAPERS.md) observes
+that both moments factor through the scales:
+
+    sum(x)   = sum_n scale[n] *  sum_b q[n, b]
+    sum(x^2) = sum_n scale[n]^2 * sum_b q[n, b]^2
+
+so the inner reductions run on the raw codes — on hardware with int8
+reduction units that halves the normalization bandwidth, and in XLA it
+keeps the moment math in one rescale per block instead of one per
+element. The normalized output still needs the per-element dequant
+(that part is irreducible), but the statistics never touch it.
+
+Tolerance oracle: ``manual_layer_norm(dequant(x))``. The blockwise
+moment association and the ``E[x^2] - mean^2`` variance form both
+differ from the reference's two-pass fp32 reduction only in float
+association, so the test bound is a documented tolerance, not
+bit-exactness.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_F32 = jnp.float32
+
+
+def mx_layer_norm(codes: jnp.ndarray, scales: jnp.ndarray,
+                  weight, bias, block: int, eps: float = 1e-5):
+    """Layer-normalize a block-quantized activation ``[..., H]`` whose
+    per-block scales are ``[..., H // block]``, reusing those scales for
+    the moment computation instead of re-reducing the dequantized
+    vector."""
+    h = int(codes.shape[-1])
+    if h % int(block) != 0:
+        raise ValueError(f"quant block {block} does not divide {h}")
+    n = h // int(block)
+    qb = codes.astype(_F32).reshape(codes.shape[:-1] + (n, int(block)))
+    s = scales.astype(_F32)
+    s1 = jnp.sum(qb, axis=-1)            # per-block integer sums
+    s2 = jnp.sum(qb * qb, axis=-1)
+    mean = jnp.sum(s1 * s, axis=-1, keepdims=True) / h
+    ex2 = jnp.sum(s2 * s * s, axis=-1, keepdims=True) / h
+    var = jnp.maximum(ex2 - mean * mean, 0.0)
+    x = (qb * s[..., None]).reshape(codes.shape)   # per-element dequant
+    y = (x - mean) * jax.lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(_F32)
+    if bias is not None:
+        y = y + bias.astype(_F32)
+    return y

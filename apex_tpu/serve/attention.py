@@ -156,7 +156,9 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
                      positions: jax.Array, *,
                      scale: Optional[float] = None,
                      block_k: Optional[int] = None,
-                     interpret: Optional[bool] = None) -> jax.Array:
+                     interpret: Optional[bool] = None,
+                     k_scale: Optional[jax.Array] = None,
+                     v_scale: Optional[jax.Array] = None) -> jax.Array:
     """Single-token attention over slot-contiguous cached K/V.
 
     ``q``: ``[num_slots, heads, head_dim]`` (this step's query per slot);
@@ -165,6 +167,12 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     positions ``0 .. positions[b]`` inclusive (its own just-appended token
     is position ``positions[b]``). Returns ``[num_slots, heads, head_dim]``
     in ``q.dtype``.
+
+    ``k_scale``/``v_scale`` (``[num_slots, max_len, heads]`` fp32, from a
+    ``kv_quant`` cache) arm per-(token, head) dequantization INSIDE the
+    chunk fetch: each streamed ``[block_k]`` tile is decoded to fp32 as
+    it is read, so the scores/combine arithmetic below never changes and
+    the dequant working set is bounded by the same ``block_k`` tile.
     """
     b, L, h, d = k_cache.shape
     bk = resolve_block_k(L, h, d, q.dtype, block_k, interpret)
@@ -176,7 +184,11 @@ def cached_attention(q: jax.Array, k_cache: jax.Array, v_cache: jax.Array,
     # premise the decode_attention autotuner times)
     def fetch(i):
         sl = slice(i * bk, (i + 1) * bk)
-        return k_cache[:, sl], v_cache[:, sl]
+        ks, vs = k_cache[:, sl], v_cache[:, sl]
+        if k_scale is not None:
+            ks = ks.astype(_f32) * k_scale[:, sl][..., None]
+            vs = vs.astype(_f32) * v_scale[:, sl][..., None]
+        return ks, vs
 
     return _combine_chunks(q, positions, L, bk, s, fetch)
 
@@ -185,7 +197,9 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
                     page_table: jax.Array, positions: jax.Array, *,
                     scale: Optional[float] = None,
                     block_k: Optional[int] = None,
-                    interpret: Optional[bool] = None) -> jax.Array:
+                    interpret: Optional[bool] = None,
+                    k_scale: Optional[jax.Array] = None,
+                    v_scale: Optional[jax.Array] = None) -> jax.Array:
     """Single-token attention through the page table.
 
     ``q``: ``[num_slots, heads, head_dim]``; ``k_pool``/``v_pool``:
@@ -200,6 +214,11 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
     combine is the SAME code, bit-for-bit. Unmapped table entries point
     at the null page; its rows sit past every live position, so the
     reachability mask discards them.
+
+    ``k_scale``/``v_scale`` (``[num_pages, page_size, heads]`` fp32, one
+    layer of a ``kv_quant`` pool's scale planes) dequantize each fetched
+    tile through the SAME page gather as the payload — the scales ride
+    the page table, so sharing/COW/eviction need no quant-aware code.
     """
     P, ps, h, d = k_pool.shape
     L = int(page_table.shape[1]) * ps
@@ -211,6 +230,10 @@ def paged_attention(q: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
         start = i * bk
         pages = page_table[:, start // ps]                 # [b]
         sl = slice(start % ps, start % ps + bk)            # static in-page
-        return k_pool[pages, sl], v_pool[pages, sl]
+        ks, vs = k_pool[pages, sl], v_pool[pages, sl]
+        if k_scale is not None:
+            ks = ks.astype(_f32) * k_scale[pages, sl][..., None]
+            vs = vs.astype(_f32) * v_scale[pages, sl][..., None]
+        return ks, vs
 
     return _combine_chunks(q, positions, L, bk, s, fetch)

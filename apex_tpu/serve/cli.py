@@ -223,7 +223,8 @@ def _run_fleet(args, cfg, max_len: int, prompts, slo) -> int:
                               prefix_cache=args.prefix_cache,
                               tp=args.tp, tp_sync=args.tp_sync,
                               spec_draft_len=args.spec_draft_len or 0,
-                              decode_policy=args.decode_policy)
+                              decode_policy=args.decode_policy,
+                              kv_quant=args.kv_quant)
     handles = []
     for i, (rid, role) in enumerate(replica_specs):
         try:
@@ -545,6 +546,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "policies in one batch never retraces "
                          "(beam-like policies are refused — no exact "
                          "per-token acceptance test exists)")
+    ap.add_argument("--kv-quant", default=None,
+                    choices=["int8", "mxfp8"],
+                    help="block-scale KV-cache quantization "
+                         "(apex_tpu.quant, docs/quantization.md): store "
+                         "K/V as codec bytes with one fp32 scale per "
+                         "(token, head); needs --dtype fp32 (the "
+                         "quality gate's reference engine) and is "
+                         "refused with --spec-draft-len (exact "
+                         "acceptance oracle vs tolerance-gated cache)")
     ap.add_argument("--stdin", action="store_true",
                     help="read one token-id request per input line")
     ap.add_argument("--aot", action="store_true",
@@ -628,6 +638,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             print(f"apex-tpu-serve: --decode-policy: {e}",
                   file=sys.stderr)
+            return 2
+
+    # KV-quantization flag matrix, BEFORE any params/compile work (same
+    # PR-10 precedent; argparse choices already refuse unknown codecs)
+    if args.kv_quant is not None:
+        if args.dtype != "fp32":
+            print(f"apex-tpu-serve: --kv-quant {args.kv_quant} needs "
+                  f"--dtype fp32: the quantization quality gate is "
+                  f"calibrated against the fp32 engine as the exact "
+                  f"reference", file=sys.stderr)
+            return 2
+        if spec_k:
+            print(f"apex-tpu-serve: --kv-quant {args.kv_quant} is "
+                  f"incompatible with --spec-draft-len {spec_k}: the "
+                  f"speculative acceptance oracle is bit-exact, the "
+                  f"quantized cache is tolerance-gated (drop one)",
+                  file=sys.stderr)
+            return 2
+        from apex_tpu.quant.kv import check_kv_codec
+        try:
+            check_kv_codec(args.kv_quant)
+        except ValueError as e:
+            print(f"apex-tpu-serve: --kv-quant: {e}", file=sys.stderr)
             return 2
 
     # disaggregation / autoscaler flag matrix, BEFORE any params or
@@ -847,7 +880,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                          prefix_cache=args.prefix_cache,
                          tp=args.tp, tp_sync=args.tp_sync,
                          spec_draft_len=args.spec_draft_len or 0,
-                         decode_policy=args.decode_policy),
+                         decode_policy=args.decode_policy,
+                         kv_quant=args.kv_quant),
             seed=args.seed)
     except ValueError as e:
         # bad pool geometry (page_size vs max_len/block_k, undersized

@@ -348,18 +348,33 @@ class DisaggController(FleetController):
         # claims; the first failed page truncates the accepted chain
         expected = paging.chunk_hashes(list(spec.tokens),
                                        int(self.page_size))
+        target_codec = getattr(target.engine, "kv_quant", None)
         accepted: List[Dict[str, Any]] = []
         refused_at = None
         refused_reason = None
         for i, p in enumerate(payloads):
             k_np = np.asarray(p["k"])
             v_np = np.asarray(p["v"])
+            if p.get("codec") != target_codec:
+                # quantization provenance mismatch: the bytes may be
+                # pristine, but the target pool would misread them
+                # (codec bytes as fp32 or vice versa) — refuse the whole
+                # chain and fall back to local re-prefill, which is
+                # exact for the target's OWN codec by construction
+                refused_at, refused_reason = i, "quant_codec"
+                break
             if i >= len(expected) or p["chain_hash"] != expected[i]:
                 refused_at, refused_reason = i, "chain_hash"
                 break
+            # quantized pages certify codes ‖ scales in ONE digest: a
+            # flipped scale bit is refused exactly like a payload bit
+            scale_bytes = ()
+            if p.get("codec") is not None:
+                scale_bytes = (np.asarray(p["k_scale"]).tobytes(),
+                               np.asarray(p["v_scale"]).tobytes())
             if paging.page_payload_digest(
-                    p["chain_hash"], k_np.tobytes(),
-                    v_np.tobytes()) != p["digest"]:
+                    p["chain_hash"], k_np.tobytes(), v_np.tobytes(),
+                    *scale_bytes) != p["digest"]:
                 refused_at, refused_reason = i, "digest"
                 break
             accepted.append(p)
@@ -379,6 +394,14 @@ class DisaggController(FleetController):
                 request_id=spec.request_id, page_index=refused_at,
                 reason=refused_reason, from_replica=source.replica_id,
                 to_replica=target.replica_id)
+            if refused_reason == "quant_codec":
+                publish_event(
+                    "serve_quant_fallback", level="warning",
+                    request_id=spec.request_id,
+                    source_codec=payloads[refused_at].get("codec"),
+                    target_codec=target_codec,
+                    from_replica=source.replica_id,
+                    to_replica=target.replica_id)
             self._resolve(ho, "refused", now)
         else:
             self.handoffs_delivered += 1

@@ -163,6 +163,17 @@ class EngineConfig:
     # as [num_slots] f32 DATA, so mixing policies in one batch never
     # retraces. Parse/validation errors are build-time ValueErrors.
     decode_policy: Optional[str] = None
+    # block-scale KV quantization (apex_tpu.quant,
+    # docs/quantization.md): None stores fp32/compute-dtype K/V; "int8"
+    # / "mxfp8" stores codec bytes plus one fp32 scale per (token,
+    # head) in the cache pytree — scales are DATA, so the one-compile
+    # invariant is untouched and scales ride prefix sharing, COW,
+    # eviction, export/import, and tp head sharding with their pages.
+    # Requires fp32 compute_dtype (the tolerance oracle is calibrated
+    # against the fp32 engine) and spec_draft_len == 0 (the spec
+    # acceptance oracle is bit-exact; quant is tolerance-based — the
+    # combination is refused until proven, the repo's standing policy).
+    kv_quant: Optional[str] = None
 
 
 class Engine:
@@ -269,6 +280,30 @@ class Engine:
         self._policy = (serve_spec.parse_policy(
             config.decode_policy, spec_draft_len=self._spec_k)
             if config.decode_policy is not None else None)
+        # block-scale KV quantization: codec validation is build-time
+        # (unknown codec / missing float8 support), and the two
+        # incompatible knob combinations are refused loudly rather than
+        # served unproven — kv_quant needs the fp32 engine as its
+        # tolerance reference, and speculation's acceptance oracle is
+        # bit-exact where quant is tolerance-based
+        self._kv_quant = config.kv_quant
+        if self._kv_quant is not None:
+            from apex_tpu.quant.kv import check_kv_codec
+
+            check_kv_codec(self._kv_quant)
+            if model_cfg.compute_dtype != jnp.float32:
+                raise ValueError(
+                    f"kv_quant={self._kv_quant!r} requires "
+                    f"compute_dtype=float32: the quantization quality "
+                    f"gate (quant_ppl_delta) is calibrated against the "
+                    f"fp32 engine as the exact reference")
+            if self._spec_k:
+                raise ValueError(
+                    f"kv_quant={self._kv_quant!r} is incompatible with "
+                    f"spec_draft_len={self._spec_k}: the speculative "
+                    f"acceptance oracle is bit-exact, the quantized "
+                    f"cache is tolerance-gated — the combination is "
+                    f"refused until separately proven")
         self._init_state(seed)
 
         # trace counters: tier-1 asserts decode_traces == 1 across a full
@@ -331,6 +366,7 @@ class Engine:
             return gpt2_token_forward(self.model_cfg, self.params, cache,
                                       tokens, positions, mask,
                                       block_k=self.block_k,
+                                      kv_quant=self._kv_quant,
                                       final_scope=final_scope)
         # tensor-parallel: the SAME call sites (decode_fn, the prefill
         # scan body) lower the per-rank forward under shard_map — the
@@ -346,7 +382,7 @@ class Engine:
             return gpt2_token_forward_tp(
                 self.model_cfg, self._tp, self.config.tp_sync, params,
                 cache, tokens, positions, mask, block_k=self.block_k,
-                final_scope=final_scope)
+                kv_quant=self._kv_quant, final_scope=final_scope)
 
         fn = shard_map(rank_body, mesh=self.mesh,
                        in_specs=(self._tp_param_specs, specs, P(), P(),
@@ -562,7 +598,8 @@ class Engine:
             ps = int(self.config.page_size)
             self.cache: Any = init_paged_cache(
                 self.model_cfg.n_layer, b, self.max_len, ps,
-                self._num_pages, h, d, self.model_cfg.compute_dtype)
+                self._num_pages, h, d, self.model_cfg.compute_dtype,
+                kv_quant=self._kv_quant)
             self.pool: Optional[PagePool] = PagePool(self._num_pages, ps)
             self.prefix: Optional[PrefixIndex] = \
                 PrefixIndex(ps) if self.config.prefix_cache else None
@@ -574,7 +611,7 @@ class Engine:
         else:
             self.cache = init_cache(
                 self.model_cfg.n_layer, b, self.max_len, h, d,
-                self.model_cfg.compute_dtype)
+                self.model_cfg.compute_dtype, kv_quant=self._kv_quant)
             self.pool = None
             self.prefix = None
             self._slot_pages = [[] for _ in range(b)]
@@ -772,6 +809,7 @@ class Engine:
         starts = np.zeros((b,), np.int32)
         tails: Dict[int, Sequence[int]] = dict(prompts)
         self.last_prefill_stats = {}
+        quant_pages = 0
         if self._paged:
             ps = int(self.config.page_size)
             for slot in prompts:
@@ -805,6 +843,7 @@ class Engine:
                         plan["new_pages"] - self.pool.free_count,
                         protect=protect_all)
                 fresh = self.pool.alloc(plan["new_pages"])
+                quant_pages += len(fresh)
                 for pg in shared:
                     self.pool.retain(pg)
                 if plan["cow_src"] is not None:
@@ -868,6 +907,13 @@ class Engine:
                 for i, h in enumerate(
                         paging.chunk_hashes(list(toks[:upto]), ps)):
                     self.prefix.insert(h, row[i], self.pool)
+        if self._paged and self._kv_quant is not None:
+            # quantized-capacity provenance: these pages now hold codec
+            # bytes + scales, not fp32 rows — counted so a bench capture
+            # can prove its resident_tokens_per_hbm_byte came from a
+            # quantized pool, not a mislabeled fp32 one
+            publish_event("serve_kv_quantized_pages", pages=quant_pages,
+                          codec=self._kv_quant)
         return first_np, last_logits, all_logits
 
     def decode_step(self, last_tokens, active):
@@ -1024,11 +1070,26 @@ class Engine:
         for h, page in self.prefix.lookup(tokens, touch=False):
             k_np = np.asarray(jax.device_get(self.cache.k[:, page]))
             v_np = np.asarray(jax.device_get(self.cache.v[:, page]))
-            out.append({
-                "chain_hash": h, "k": k_np, "v": v_np,
-                "digest": paging.page_payload_digest(
-                    h, k_np.tobytes(), v_np.tobytes()),
-            })
+            entry = {"chain_hash": h, "k": k_np, "v": v_np,
+                     "codec": self._kv_quant}
+            if self._kv_quant is not None:
+                # quantized payloads ship their scale planes, and the
+                # digest covers codes ‖ scales together: a flipped
+                # scale bit fails certification exactly like a flipped
+                # payload bit
+                ks_np = np.asarray(
+                    jax.device_get(self.cache.k_scale[:, page]))
+                vs_np = np.asarray(
+                    jax.device_get(self.cache.v_scale[:, page]))
+                entry["k_scale"] = ks_np
+                entry["v_scale"] = vs_np
+                entry["digest"] = paging.page_payload_digest(
+                    h, k_np.tobytes(), v_np.tobytes(),
+                    ks_np.tobytes(), vs_np.tobytes())
+            else:
+                entry["digest"] = paging.page_payload_digest(
+                    h, k_np.tobytes(), v_np.tobytes())
+            out.append(entry)
         return out
 
     def import_prefix_pages(self, payloads) -> Dict[str, int]:
@@ -1066,6 +1127,12 @@ class Engine:
                     f"migrated page payload shape {np.shape(p['k'])} != "
                     f"engine page shape {shape} (torn transfer should "
                     f"have been refused at certification)")
+            if p.get("codec") != self._kv_quant:
+                raise ValueError(
+                    f"migrated page codec {p.get('codec')!r} != engine "
+                    f"kv_quant {self._kv_quant!r} (a codec mismatch "
+                    f"should have been refused at certification — "
+                    f"installing it would misread the pool bytes)")
             if p["chain_hash"] in self.prefix:
                 stats["duplicate"] += 1
                 continue
@@ -1078,14 +1145,24 @@ class Engine:
                     stats["installed"] + stats["duplicate"])
                 break
             page = self.pool.alloc(1)[0]
-            self.cache = kv_cache.install_page(
-                self.cache, page, jnp.asarray(p["k"]),
-                jnp.asarray(p["v"]))
+            if self._kv_quant is not None:
+                self.cache = kv_cache.install_page(
+                    self.cache, page, jnp.asarray(p["k"]),
+                    jnp.asarray(p["v"]), jnp.asarray(p["k_scale"]),
+                    jnp.asarray(p["v_scale"]))
+            else:
+                self.cache = kv_cache.install_page(
+                    self.cache, page, jnp.asarray(p["k"]),
+                    jnp.asarray(p["v"]))
             self.prefix.insert(p["chain_hash"], page, self.pool)
             # index-only residency (refcount 1): admission shares it
             # read-only like any local prefix hit; LRU can reclaim it
             self.pool.release(page)
             stats["installed"] += 1
+        if self._kv_quant is not None and stats["installed"]:
+            publish_event("serve_kv_quantized_pages",
+                          pages=stats["installed"],
+                          codec=self._kv_quant)
         return stats
 
     @property
@@ -1175,6 +1252,8 @@ class Engine:
             "vocab_size": int(self.model_cfg.vocab_size),
             "spec_draft_len": int(self._spec_k),
             "decode_policy": self.config.decode_policy,
+            "kv_quant": self._kv_quant,
+            "quant_block": int(self.quant_block),
         }
         return costs.build_ledger(execs, workload,
                                   chip=chip or detect_chip() or "cpu")
@@ -1209,13 +1288,34 @@ class Engine:
         return free / max(self.pool.capacity, 1)
 
     @property
+    def kv_quant(self) -> Optional[str]:
+        """The armed KV codec (``"int8"``/``"mxfp8"``) or None."""
+        return self._kv_quant
+
+    @property
+    def quant_block(self) -> int:
+        """Quantization block size (elements per scale): the head_dim
+        when ``kv_quant`` is armed — one scale per (token, head) vector —
+        else 0 (unquantized). A workload-provenance axis: captures at
+        different blocks are incomparable."""
+        if self._kv_quant is None:
+            return 0
+        return int(self.model_cfg.n_embd // self.model_cfg.n_head)
+
+    @property
     def kv_cache_bytes(self) -> int:
         """Resident bytes of the KV buffers — the slot cache's
         ``num_slots * max_len`` reservation, or the paged pool's
-        ``num_pages * page_size``; stamped into the serving AOT
+        ``num_pages * page_size``, INCLUDING the fp32 scale planes when
+        ``kv_quant`` is armed (the capacity win must be priced net of
+        its scale overhead); stamped into the serving AOT
         ``hbm_snapshot`` and the bench's
         ``resident_tokens_per_hbm_byte`` so captures carry it."""
-        return int(self.cache.k.nbytes) + int(self.cache.v.nbytes)
+        total = int(self.cache.k.nbytes) + int(self.cache.v.nbytes)
+        if self.cache.k_scale is not None:
+            total += int(self.cache.k_scale.nbytes)
+            total += int(self.cache.v_scale.nbytes)
+        return total
 
 
 def init_gpt2_params(cfg: GPT2Config, seed: int = 0):

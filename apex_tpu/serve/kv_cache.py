@@ -24,11 +24,22 @@ All mutators are pure functions returning a new cache (the engine's
 jitted callables donate nothing and alias nothing). Masked writes
 read-modify-write the existing token so an inactive slot's bytes are
 untouched — slot isolation is structural, not best-effort.
+
+Block-scale quantization (``EngineConfig(kv_quant=...)``) changes the
+VALUES, never the structure of this contract: ``k``/``v`` hold codec
+bytes (int8 / float8_e4m3fn) and two extra pytree fields
+``k_scale``/``v_scale`` hold one fp32 scale per (token, head) — shaped
+like the payload minus the head_dim axis, so scales ride every page
+behaviour (prefix sharing, COW, eviction, export/import, tp head
+sharding) through the exact same code paths as the payload. On an
+unquantized cache both fields are ``None`` — an empty pytree node, so
+legacy pytrees are structurally identical to before the feature
+existed.
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import flax.struct
 import jax
@@ -42,6 +53,10 @@ class KVCache:
     k: jax.Array        # [n_layer, num_slots, max_len, heads, head_dim]
     v: jax.Array        # same shape as k
     lengths: jax.Array  # [num_slots] int32 — tokens resident per slot
+    # per-(token, head) fp32 codec scales when kv_quant is armed:
+    # [n_layer, num_slots, max_len, heads]; None when unquantized
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def n_layer(self) -> int:
@@ -57,18 +72,30 @@ class KVCache:
 
 
 def init_cache(n_layer: int, num_slots: int, max_len: int, heads: int,
-               head_dim: int, dtype: Any = jnp.float32) -> KVCache:
+               head_dim: int, dtype: Any = jnp.float32,
+               kv_quant: Optional[str] = None) -> KVCache:
     """Allocate an empty cache. ``max_len`` bounds every request's total
     context (prompt + generated); the scheduler terminates a request that
-    reaches it."""
+    reaches it. With ``kv_quant`` the payload arrays take the codec's
+    storage dtype and the fp32 scale planes are allocated alongside."""
     shape = (n_layer, num_slots, max_len, heads, head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-                   lengths=jnp.zeros((num_slots,), jnp.int32))
+    lengths = jnp.zeros((num_slots,), jnp.int32)
+    if kv_quant is None:
+        return KVCache(k=jnp.zeros(shape, dtype),
+                       v=jnp.zeros(shape, dtype), lengths=lengths)
+    from apex_tpu.quant.kv import kv_storage_dtype
+
+    sdtype = kv_storage_dtype(kv_quant)
+    return KVCache(
+        k=jnp.zeros(shape, sdtype), v=jnp.zeros(shape, sdtype),
+        lengths=lengths,
+        k_scale=jnp.zeros(shape[:-1], jnp.float32),
+        v_scale=jnp.zeros(shape[:-1], jnp.float32))
 
 
 def write_token(cache: KVCache, layer: int, k_tok: jax.Array,
                 v_tok: jax.Array, positions: jax.Array,
-                mask: jax.Array) -> KVCache:
+                mask: jax.Array, codec: Optional[str] = None) -> KVCache:
     """Write one token's K/V per slot at ``positions[slot]`` where
     ``mask[slot]`` — the append primitive of both prefill and decode.
 
@@ -77,23 +104,42 @@ def write_token(cache: KVCache, layer: int, k_tok: jax.Array,
     python int (the model unrolls its layers), so the layer slice is
     static. Masked-off slots get their current token written back
     bit-for-bit; shapes never change, so this is recompile-free under jit.
+
+    With ``codec`` the token is block-scale encoded (one scale per head)
+    and codes + scales land in the same masked read-modify-write — the
+    scale write obeys the identical slot-isolation contract as the
+    payload write.
     """
-    def _one(buf, tok, pos):       # buf [L, h, d], tok [h, d]
-        return jax.lax.dynamic_update_slice(buf, tok[None], (pos, 0, 0))
+    def _one(buf, tok, pos):       # buf [L, ...], tok [...]
+        return jax.lax.dynamic_update_slice(
+            buf, tok[None], (pos,) + (0,) * tok.ndim)
 
     def _read(buf, pos):
         return jax.lax.dynamic_slice(
-            buf, (pos, 0, 0), (1,) + buf.shape[1:])[0]
+            buf, (pos,) + (0,) * (buf.ndim - 1), (1,) + buf.shape[1:])[0]
 
     pos = jnp.clip(positions.astype(jnp.int32), 0, cache.max_len - 1)
     out = {}
     for name, tok in (("k", k_tok), ("v", v_tok)):
+        scales = None
+        if codec is not None:
+            from apex_tpu.quant.kv import encode_kv
+
+            tok, scales = encode_kv(codec, tok.astype(jnp.float32))
         buf = getattr(cache, name)[layer]              # [B, L, h, d]
         cur = jax.vmap(_read)(buf, pos)                # [B, h, d]
         new = jnp.where(mask[:, None, None], tok.astype(buf.dtype), cur)
         out[name] = getattr(cache, name).at[layer].set(
             jax.vmap(_one)(buf, new, pos))
-    return cache.replace(k=out["k"], v=out["v"])
+        if scales is not None:
+            sname = name + "_scale"
+            sbuf = getattr(cache, sname)[layer]        # [B, L, h]
+            scur = jax.vmap(_read)(sbuf, pos)          # [B, h]
+            snew = jnp.where(mask[:, None], scales.astype(sbuf.dtype),
+                             scur)
+            out[sname] = getattr(cache, sname).at[layer].set(
+                jax.vmap(_one)(sbuf, snew, pos))
+    return cache.replace(**out)
 
 
 def advance(cache: KVCache, mask: jax.Array) -> KVCache:
@@ -142,6 +188,12 @@ class PagedKVCache:
     v: jax.Array           # same shape as k
     lengths: jax.Array     # [num_slots] int32 — tokens resident per slot
     page_table: jax.Array  # [num_slots, max_pages_per_slot] int32
+    # per-(token, head) fp32 codec scales when kv_quant is armed:
+    # [n_layer, num_pages, page_size, heads] — scales live IN the page
+    # structure, so sharing/COW/eviction/migration move them with the
+    # page for free; None when unquantized
+    k_scale: Optional[jax.Array] = None
+    v_scale: Optional[jax.Array] = None
 
     @property
     def n_layer(self) -> int:
@@ -171,7 +223,8 @@ class PagedKVCache:
 
 def init_paged_cache(n_layer: int, num_slots: int, max_len: int,
                      page_size: int, num_pages: int, heads: int,
-                     head_dim: int, dtype: Any = jnp.float32) -> PagedKVCache:
+                     head_dim: int, dtype: Any = jnp.float32,
+                     kv_quant: Optional[str] = None) -> PagedKVCache:
     """Allocate an empty page pool. ``max_len`` (must be a multiple of
     ``page_size``) bounds every request's total context; ``num_pages``
     bounds the *pool* — sizing it below ``num_slots * max_len /
@@ -189,15 +242,26 @@ def init_paged_cache(n_layer: int, num_slots: int, max_len: int,
             f"request: need max_len/page_size + 1 null page = "
             f"{max_pages + 1}")
     shape = (n_layer, num_pages, page_size, heads, head_dim)
+    lengths = jnp.zeros((num_slots,), jnp.int32)
+    table = jnp.zeros((num_slots, max_pages), jnp.int32)
+    if kv_quant is None:
+        return PagedKVCache(k=jnp.zeros(shape, dtype),
+                            v=jnp.zeros(shape, dtype),
+                            lengths=lengths, page_table=table)
+    from apex_tpu.quant.kv import kv_storage_dtype
+
+    sdtype = kv_storage_dtype(kv_quant)
     return PagedKVCache(
-        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-        lengths=jnp.zeros((num_slots,), jnp.int32),
-        page_table=jnp.zeros((num_slots, max_pages), jnp.int32))
+        k=jnp.zeros(shape, sdtype), v=jnp.zeros(shape, sdtype),
+        lengths=lengths, page_table=table,
+        k_scale=jnp.zeros(shape[:-1], jnp.float32),
+        v_scale=jnp.zeros(shape[:-1], jnp.float32))
 
 
 def paged_write_token(cache: PagedKVCache, layer: int, k_tok: jax.Array,
                       v_tok: jax.Array, positions: jax.Array,
-                      mask: jax.Array) -> PagedKVCache:
+                      mask: jax.Array,
+                      codec: Optional[str] = None) -> PagedKVCache:
     """The paged analog of :func:`write_token`: append one token's K/V
     per slot at virtual position ``positions[slot]`` — physical page
     ``page_table[slot, pos // page_size]``, row ``pos % page_size`` —
@@ -218,11 +282,23 @@ def paged_write_token(cache: PagedKVCache, layer: int, k_tok: jax.Array,
     offs = jnp.where(mask, pos % ps, 0)
     out = {}
     for name, tok in (("k", k_tok), ("v", v_tok)):
+        scales = None
+        if codec is not None:
+            from apex_tpu.quant.kv import encode_kv
+
+            tok, scales = encode_kv(codec, tok.astype(jnp.float32))
         buf = getattr(cache, name)                     # [L, P, S, h, d]
         cur = buf[layer, pages, offs]                  # [B, h, d]
         new = jnp.where(mask[:, None, None], tok.astype(buf.dtype), cur)
         out[name] = buf.at[layer, pages, offs].set(new)
-    return cache.replace(k=out["k"], v=out["v"])
+        if scales is not None:
+            sname = name + "_scale"
+            sbuf = getattr(cache, sname)               # [L, P, S, h]
+            scur = sbuf[layer, pages, offs]            # [B, h]
+            snew = jnp.where(mask[:, None], scales.astype(sbuf.dtype),
+                             scur)
+            out[sname] = sbuf.at[layer, pages, offs].set(snew)
+    return cache.replace(**out)
 
 
 # ------------------------------------------------- tensor-parallel layout
@@ -244,9 +320,13 @@ def tp_cache_specs(cache, axis: str = "tp"):
     from jax.sharding import PartitionSpec as P
 
     kv = P(None, None, None, axis, None)
+    # scale planes end on the head axis — scales shard with their pages
+    # on the tp head axis by construction, not by a separate code path
+    sc = None if cache.k_scale is None else P(None, None, None, axis)
     if hasattr(cache, "page_table"):
-        return PagedKVCache(k=kv, v=kv, lengths=P(), page_table=P())
-    return KVCache(k=kv, v=kv, lengths=P())
+        return PagedKVCache(k=kv, v=kv, lengths=P(), page_table=P(),
+                            k_scale=sc, v_scale=sc)
+    return KVCache(k=kv, v=kv, lengths=P(), k_scale=sc, v_scale=sc)
 
 
 def shard_cache(cache, mesh, axis: str = "tp"):
@@ -274,6 +354,8 @@ def shard_cache(cache, mesh, axis: str = "tp"):
     out = cache.replace(k=put("k"), v=put("v"), lengths=put("lengths"))
     if hasattr(cache, "page_table"):
         out = out.replace(page_table=put("page_table"))
+    if cache.k_scale is not None:
+        out = out.replace(k_scale=put("k_scale"), v_scale=put("v_scale"))
     return out
 
 
@@ -287,9 +369,14 @@ def copy_page(cache: PagedKVCache, src, dst) -> PagedKVCache:
     shared prefix page whose tail it must append into."""
     src = jnp.asarray(src, jnp.int32)
     dst = jnp.asarray(dst, jnp.int32)
-    return cache.replace(
+    out = cache.replace(
         k=cache.k.at[:, dst].set(cache.k[:, src]),
         v=cache.v.at[:, dst].set(cache.v[:, src]))
+    if cache.k_scale is not None:
+        out = out.replace(
+            k_scale=cache.k_scale.at[:, dst].set(cache.k_scale[:, src]),
+            v_scale=cache.v_scale.at[:, dst].set(cache.v_scale[:, src]))
+    return out
 
 
 # host-callable page install: ONE jitted op (the page index is a traced
@@ -301,12 +388,22 @@ def copy_page(cache: PagedKVCache, src, dst) -> PagedKVCache:
 # the receiving allocator chose.
 @jax.jit
 def install_page(cache: PagedKVCache, page, k_page: jax.Array,
-                 v_page: jax.Array) -> PagedKVCache:
+                 v_page: jax.Array, k_scale_page=None,
+                 v_scale_page=None) -> PagedKVCache:
     """Write a whole page's K/V payload into pool slot ``page`` across
     every layer. ``k_page``/``v_page``: ``[n_layer, page_size, heads,
-    head_dim]``. The caller owns ``page`` (freshly allocated, refcount
-    held), so the scatter can never alias a live slot's append."""
+    head_dim]``; on a quantized cache the caller also supplies the
+    page's scale planes ``[n_layer, page_size, heads]``. The caller
+    owns ``page`` (freshly allocated, refcount held), so the scatter
+    can never alias a live slot's append."""
     page = jnp.asarray(page, jnp.int32)
-    return cache.replace(
+    out = cache.replace(
         k=cache.k.at[:, page].set(k_page.astype(cache.k.dtype)),
         v=cache.v.at[:, page].set(v_page.astype(cache.v.dtype)))
+    if k_scale_page is not None:
+        out = out.replace(
+            k_scale=cache.k_scale.at[:, page].set(
+                k_scale_page.astype(cache.k_scale.dtype)),
+            v_scale=cache.v_scale.at[:, page].set(
+                v_scale_page.astype(cache.v_scale.dtype)))
+    return out

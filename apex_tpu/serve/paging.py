@@ -67,7 +67,7 @@ def chunk_hashes(tokens: Sequence[int], page_size: int) -> List[str]:
 
 
 def page_payload_digest(chain_hash: str, k_bytes: bytes,
-                        v_bytes: bytes) -> str:
+                        v_bytes: bytes, *extra: bytes) -> str:
     """Transport digest for one migrated KV page: blake2b over the chain
     hash it claims plus the raw K/V bytes. The sender stamps it at
     export; the receiver recomputes it over what actually arrived, so a
@@ -75,11 +75,19 @@ def page_payload_digest(chain_hash: str, k_bytes: bytes,
     *claimed* chain hash still matches the receiver's expectation. Two
     independent checks, two failure classes: the chain hash certifies
     "these are the pages for THIS prompt prefix", the payload digest
-    certifies "these bytes are the ones the prefill replica committed"."""
+    certifies "these bytes are the ones the prefill replica committed".
+
+    ``extra`` carries any further byte planes the page's meaning depends
+    on — a quantized page passes its K/V scale planes here, so the
+    digest certifies codes ‖ scales TOGETHER: a flipped bit in a scale
+    (which would silently rescale a whole (token, head) block at
+    dequant) is refused exactly like a flipped payload bit."""
     h = hashlib.blake2b(digest_size=16)
     h.update(bytes.fromhex(chain_hash))
     h.update(k_bytes)
     h.update(v_bytes)
+    for b in extra:
+        h.update(b)
     return h.hexdigest()
 
 

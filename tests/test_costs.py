@@ -197,6 +197,28 @@ def test_cost_ledger_paged_reconciles(params):
                for r in costs.provenance_mismatch(led, slot))
 
 
+def test_cost_ledger_quantized_reconciles(params):
+    """PR-20 ride-along: a kv_quant engine's ledger reconciles exactly
+    (the in-step encode/dequant arithmetic and the int8 KV traffic are
+    walked like any other op), stamps ``kv_quant``/``quant_block``
+    provenance so quantized ledgers refuse to gate against fp32 ones,
+    and its decode step moves FEWER HBM bytes per token than the fp32
+    engine's — the capacity claim, visible in the static byte model."""
+    eng = _engine(params, kv_quant="int8")
+    led = eng.cost_ledger()
+    for rec in led["executables"].values():
+        _assert_reconciles(rec)
+    assert led["workload"]["kv_quant"] == "int8"
+    assert led["workload"]["quant_block"] == 8     # = head_dim
+    plain = _engine(params).cost_ledger()
+    assert any("kv_quant" in r
+               for r in costs.provenance_mismatch(led, plain))
+    assert "kv_quant" not in plain["workload"] \
+        or plain["workload"]["kv_quant"] is None
+    assert led["derived"]["decode_hbm_bytes_per_token"] \
+        < plain["derived"]["decode_hbm_bytes_per_token"]
+
+
 def test_cost_ledger_tp2_exact_matches_pr15_contract(params, tp_devices):
     eng = _engine(params, num_slots=2, tp=2)
     led = eng.cost_ledger()

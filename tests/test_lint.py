@@ -758,6 +758,55 @@ def test_apx002_covers_topology_reshard_table(tmp_path):
     assert not active, [v.format() for v in active]
 
 
+def test_apx002_covers_quant_scale_table(tmp_path):
+    """PR-20 coverage proof: the real quantized KV path keeps scales as
+    DEVICE arrays in the cache pytree (no host table, nothing for
+    APX002 to say) — but the tempting host-side mirror of per-page
+    scale amax stats (for requant heuristics) mutated lock-free from
+    the page-delivery callback needs a lock the moment it appears: two
+    concurrent deliveries would lose updates and mis-scale a requant.
+    The lock-disciplined spelling stays quiet."""
+    _fixture(tmp_path, "apex_tpu/quant/scale_table.py", """\
+        import threading
+
+        class ScaleStatsTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._amax = {}
+
+            def register_page(self, page):
+                with self._lock:
+                    self._amax[page] = 0.0
+
+            def on_page_delivered(self, page, amax):
+                # delivery callback thread — lock-free mutation
+                self._amax[page] = amax
+        """)
+    active, _ = _run(tmp_path, "APX002")
+    assert len(active) == 1
+    assert "lock-free" in active[0].message
+
+    good = tmp_path / "apex_tpu" / "quant" / "scale_table.py"
+    good.write_text(textwrap.dedent("""\
+        import threading
+
+        class ScaleStatsTable:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._amax = {}
+
+            def register_page(self, page):
+                with self._lock:
+                    self._amax[page] = 0.0
+
+            def on_page_delivered(self, page, amax):
+                with self._lock:
+                    self._amax[page] = amax
+        """))
+    active, _ = _run(tmp_path, "APX002")
+    assert not active, [v.format() for v in active]
+
+
 def test_apx005_covers_train_preempt_drain_stamp(tmp_path):
     """PR-14 coverage proof: a trainer preemption drain whose
     ``train_preempt_drain`` seconds are computed from ``time.time()``

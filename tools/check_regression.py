@@ -109,7 +109,13 @@ _LOWER_HINTS = ("ttft", "latency", "_p50", "_p99", "queue_wait",
                 # topology-portable checkpoints (PR 19): a quarantine
                 # storm (bit-rotted blobs) or unexpected reshard churn
                 # on restore gates off a zero baseline
-                "ckpt_quarantined", "topology_restored")
+                "ckpt_quarantined", "topology_restored",
+                # block-scale KV quantization (PR 20): the perplexity
+                # delta of a quantized engine vs its fp32 reference —
+                # quality erosion, strictly worse as it grows; and the
+                # codec-mismatch fallback counter (each one is a
+                # refused handoff that re-prefilled locally)
+                "quant_ppl_delta", "quant_fallback")
 # throughput/utilization names trump the time suffixes ("tokens_per_s"
 # ends in "_s" but is a rate). "hit_rate" (paged-KV prefix cache) must
 # beat the "_rate" lower-hint family: fewer hits means more repeated
@@ -127,7 +133,13 @@ _HIGHER_HINTS = ("_per_s", "per_sec", "_frac", "mfu", "tflops",
                  # paying, so a drop is a strict regression
                  # ("tokens"/"_per_s" already match the throughput names;
                  # listed for the explicit record)
-                 "accepted_tokens_per_step", "accept_rate")
+                 "accepted_tokens_per_step", "accept_rate",
+                 # block-scale KV quantization (PR 20): resident tokens
+                 # per KV-cache HBM byte — THE capacity win a quantized
+                 # pool exists for; a drop means the pool got more
+                 # expensive per token ("tokens" already matches, listed
+                 # for the explicit record)
+                 "resident_tokens_per_hbm_byte")
 # failure fractions beat the generic "_frac" higher family (the mirror
 # of the hit_rate-vs-_rate precedent): a snapshot's shed_frac or
 # deadline_miss_frac going UP is strictly worse — without the override
@@ -444,7 +456,14 @@ INCOMPARABLE_WORKLOAD_KEYS = {"tp": 1, "tp_sync": None,
                               "disagg": False, "roles": None,
                               "diurnal": False,
                               "spec": False, "draft_len": 0,
-                              "decode_policy": None}
+                              "decode_policy": None,
+                              # block-scale KV quantization (PR 20): a
+                              # quantized capture's capacity/latency
+                              # numbers must never gate against an fp32
+                              # baseline (or across codecs/blocks).
+                              # Missing keys = unquantized, the
+                              # pre-quant default.
+                              "kv_quant": None, "quant_block": 0}
 
 
 def incomparable_entries(cur_doc: dict, base_doc: dict) -> Dict[str, str]:
